@@ -1,0 +1,22 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch re-design of LightGBM's capabilities (reference mounted at
+/root/reference) for TPU hardware: host-side binning/IO, JAX/XLA (and
+Pallas) kernels for histogram construction, split search, partitioning and
+prediction, and data-parallel training via jax.sharding over a device mesh
+instead of the reference's socket/MPI collectives.
+
+Public surface:
+  - CLI: `python -m lightgbm_tpu config=train.conf [key=value ...]`
+    (accepts the reference's config files unchanged)
+  - Python API: Dataset/Booster (api.py) mirroring the reference C API's
+    operations (dataset from file/array, booster create/update/eval/
+    predict/save).
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config                      # noqa: F401
+from .io.dataset import Dataset, load_dataset   # noqa: F401
+from .models.gbdt import GBDT, DART             # noqa: F401
+from .models.tree import Tree                   # noqa: F401

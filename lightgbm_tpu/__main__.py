@@ -1,0 +1,6 @@
+"""CLI entry: python -m lightgbm_tpu key=value ..."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

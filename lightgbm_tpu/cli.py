@@ -1,0 +1,166 @@
+"""Application layer: `python -m lightgbm_tpu key=value ...`.
+
+Mirrors the reference CLI (src/application/application.cpp, src/main.cpp):
+same key=value arguments, config-file handling, train/predict tasks, and
+iteration logging, so the reference examples' train.conf/predict.conf run
+unchanged.  The Network::Init socket bootstrap is replaced by the JAX mesh
+(parallel/), selected by tree_learner=data.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from . import config as config_mod
+from .config import Config
+from .io.dataset import Dataset, load_dataset
+from .metrics import create_metrics, Metric
+from .models.gbdt import (GBDT, NO_LIMIT, boosting_type_from_model_file,
+                          create_boosting)
+from .objectives import create_objective
+from .io.parser import parse_file_lines
+from .utils import log
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        params = config_mod.load_parameters(argv)
+        self.config = Config.from_params(params)
+
+    def run(self) -> None:
+        if self.config.task == "train":
+            self.init_train()
+            self.train()
+        else:
+            self.init_predict()
+            self.predict()
+
+    # ------------------------------------------------------------------
+    def init_train(self) -> None:
+        cfg = self.config
+        self.boosting_old: Optional[GBDT] = None
+        if cfg.input_model:
+            # continued training (application.cpp:106-180): predict init
+            # scores with the old model
+            self.boosting_old = GBDT(cfg, None, None)
+            with open(cfg.input_model) as f:
+                self.boosting_old.load_model_from_string(f.read())
+
+        self.objective = create_objective(cfg)
+        start = time.time()
+        self.train_data = load_dataset(cfg.data, cfg)
+        if self.boosting_old is not None:
+            self._set_init_scores(self.train_data, cfg.data)
+        self.train_metrics = []
+        for m in create_metrics(cfg):
+            m.init("training", self.train_data.metadata,
+                   self.train_data.num_data)
+            self.train_metrics.append(m)
+
+        self.valid_datas: List[Dataset] = []
+        self.valid_metricss: List[List[Metric]] = []
+        for fname in cfg.valid_data:
+            vd = load_dataset(fname, cfg, reference=self.train_data)
+            if self.boosting_old is not None:
+                self._set_init_scores(vd, fname)
+            ms = []
+            for m in create_metrics(cfg):
+                m.init(fname, vd.metadata, vd.num_data)
+                ms.append(m)
+            self.valid_datas.append(vd)
+            self.valid_metricss.append(ms)
+        log.info("Finished loading data, %f seconds used"
+                 % (time.time() - start))
+
+        self.objective.init(self.train_data.metadata,
+                            self.train_data.num_data)
+        tm = self.train_metrics if cfg.is_training_metric else []
+        self.boosting = create_boosting(cfg, self.train_data, self.objective,
+                                        tm)
+        if self.boosting_old is not None:
+            # bring over the already-trained trees so saved models contain
+            # the full ensemble
+            self.boosting.models = list(self.boosting_old.models)
+            self.boosting.num_used_model = (
+                len(self.boosting.models) // cfg.num_class)
+        for vd, ms in zip(self.valid_datas, self.valid_metricss):
+            self.boosting.add_valid_data(vd, ms)
+        log.info("Finished initializing training")
+
+    def _set_init_scores(self, ds: Dataset, fname: str) -> None:
+        with open(fname) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if self.config.has_header:
+            lines = lines[1:]
+        _, feats, _ = parse_file_lines(lines, ds.label_idx)
+        raw = self.boosting_old.predict_raw(feats)   # [K, N]
+        ds.metadata.init_score = raw.reshape(-1).astype(np.float64)
+
+    def train(self) -> None:
+        cfg = self.config
+        log.info("Started training...")
+        start = time.time()
+        is_finished = False
+        for it in range(cfg.num_iterations):
+            if is_finished:
+                break
+            is_finished = self.boosting.train_one_iter(None, None, True)
+            log.info("%f seconds elapsed, finished iteration %d"
+                     % (time.time() - start, it + 1))
+            self.boosting.save_model_to_file(NO_LIMIT, is_finished,
+                                             cfg.output_model)
+        self.boosting.save_model_to_file(NO_LIMIT, True, cfg.output_model)
+        log.info("Finished training")
+
+    # ------------------------------------------------------------------
+    def init_predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("Need a model file for prediction (input_model)")
+        btype = boosting_type_from_model_file(cfg.input_model)
+        cfg.boosting_type = btype
+        self.boosting = GBDT(cfg, None, None)
+        with open(cfg.input_model) as f:
+            self.boosting.load_model_from_string(f.read())
+        self.boosting.set_num_used_model(
+            cfg.num_model_predict * self.boosting.num_class
+            if cfg.num_model_predict >= 0 else NO_LIMIT)
+
+    def predict(self) -> None:
+        """File prediction (reference src/application/predictor.hpp:82-130)."""
+        cfg = self.config
+        log.info("Started prediction...")
+        with open(cfg.data) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if cfg.has_header:
+            lines = lines[1:]
+        _, feats, _ = parse_file_lines(lines, self.boosting.label_idx)
+        if cfg.is_predict_leaf_index:
+            out = self.boosting.predict_leaf_index(feats)   # [N, T]
+            rows = ("\t".join(str(int(v)) for v in row) for row in out)
+        else:
+            if cfg.is_predict_raw_score:
+                res = self.boosting.predict_raw(feats)       # [K, N]
+            else:
+                res = self.boosting.predict(feats)
+            rows = ("\t".join("%g" % v for v in res[:, i])
+                    for i in range(res.shape[1]))
+        with open(cfg.output_result, "w") as f:
+            for row in rows:
+                f.write(row + "\n")
+        log.info("Finished prediction, results saved to %s"
+                 % cfg.output_result)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        Application(argv).run()
+    except Exception as ex:  # mirror main.cpp's catch-and-report
+        sys.stderr.write("Met Exceptions:\n%s\n" % ex)
+        return 1
+    return 0

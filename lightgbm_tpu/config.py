@@ -1,0 +1,397 @@
+"""Configuration system.
+
+Re-implements the reference's key=value config surface (same keys, same
+~60-entry alias table, same defaults and conflict checks) so the reference
+`examples/*/train.conf` files run unchanged:
+  - key list + defaults: reference include/LightGBM/config.h:89-245
+  - alias table:         reference include/LightGBM/config.h:303-378
+  - conflict checks:     reference src/io/config.cpp:129-177
+  - CLI/config-file precedence (CLI wins, `#` comments):
+                         reference src/application/application.cpp:46-104
+
+TPU-specific additions (not in the reference) are grouped at the bottom of
+Config; they control the JAX mesh instead of the socket/MPI bootstrap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .utils import log
+
+NO_LIMIT = -1
+
+ALIAS_TABLE: Dict[str, str] = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "num_classes": "num_class",
+}
+
+
+def _parse_bool(v: str) -> bool:
+    # reference ConfigBase::GetBool accepts false/-/0 as false, true/+/1 as true
+    s = v.strip().lower()
+    if s in ("false", "-", "0"):
+        return False
+    if s in ("true", "+", "1"):
+        return True
+    log.fatal("Parameter value should be \"true\"/\"+\"/\"1\" or \"false\"/\"-\"/\"0\", got \"%s\"" % v)
+
+
+@dataclasses.dataclass
+class Config:
+    """All hyper-parameters, flattened (the reference nests them in
+    OverallConfig{IO,Boosting{Tree},Objective,Metric,Network}Config; a flat
+    dataclass is the idiomatic Python equivalent)."""
+
+    # -- task / top-level ------------------------------------------------
+    task: str = "train"                   # train | predict
+    num_threads: int = 0
+    boosting_type: str = "gbdt"           # gbdt | dart
+    objective: str = "regression"         # regression | binary | multiclass | lambdarank
+    metric: List[str] = dataclasses.field(default_factory=list)
+    tree_learner: str = "serial"          # serial | feature | data
+    is_parallel: bool = False
+    is_parallel_find_bin: bool = False
+
+    # -- IO --------------------------------------------------------------
+    max_bin: int = 256
+    num_class: int = 1
+    data_random_seed: int = 1
+    data: str = ""
+    valid_data: List[str] = dataclasses.field(default_factory=list)
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    input_model: str = ""
+    verbose: int = 1
+    num_model_predict: int = NO_LIMIT
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_load_from_binary_file: bool = True
+    bin_construct_sample_cnt: int = 50000
+    is_predict_leaf_index: bool = False
+    is_predict_raw_score: bool = False
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+
+    # -- objective -------------------------------------------------------
+    sigmoid: float = 1.0
+    label_gain: List[float] = dataclasses.field(default_factory=list)
+    max_position: int = 20
+    is_unbalance: bool = False
+
+    # -- metric ----------------------------------------------------------
+    ndcg_eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+
+    # -- tree ------------------------------------------------------------
+    min_data_in_leaf: int = 100
+    min_sum_hessian_in_leaf: float = 10.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 127
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    histogram_pool_size: float = NO_LIMIT
+    max_depth: int = NO_LIMIT
+
+    # -- boosting --------------------------------------------------------
+    metric_freq: int = 1                  # reference BoostingConfig::output_freq
+    is_training_metric: bool = False
+    num_iterations: int = 10
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    drop_rate: float = 0.01
+    drop_seed: int = 4
+
+    # -- network (reference socket/MPI keys, accepted for config-file
+    #    compatibility; the JAX process bootstrap replaces their function) --
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+
+    # -- TPU-native additions --------------------------------------------
+    num_shards: int = 0                   # 0 = all visible devices when tree_learner=data
+    hist_dtype: str = "float32"           # histogram accumulator dtype
+    donate_buffers: bool = True
+
+    # ---------------------------------------------------------------------
+    @staticmethod
+    def from_params(params: Dict[str, str]) -> "Config":
+        params = apply_aliases(params)
+        c = Config()
+        getp = params.get
+
+        def set_int(key, attr=None):
+            if key in params:
+                setattr(c, attr or key, int(params[key]))
+
+        def set_float(key, attr=None):
+            if key in params:
+                setattr(c, attr or key, float(params[key]))
+
+        def set_bool(key, attr=None):
+            if key in params:
+                setattr(c, attr or key, _parse_bool(params[key]))
+
+        def set_str(key, attr=None):
+            if key in params:
+                setattr(c, attr or key, params[key].strip())
+
+        # top-level
+        set_int("num_threads")
+        if "task" in params:
+            t = getp("task").lower()
+            if t in ("train", "training"):
+                c.task = "train"
+            elif t in ("predict", "prediction", "test"):
+                c.task = "predict"
+            else:
+                log.fatal("Unknown task type %s" % t)
+        if "boosting_type" in params:
+            b = getp("boosting_type").lower()
+            if b in ("gbdt", "gbrt"):
+                c.boosting_type = "gbdt"
+            elif b == "dart":
+                c.boosting_type = "dart"
+            else:
+                log.fatal("Unknown boosting type %s" % b)
+        if "objective" in params:
+            c.objective = getp("objective").lower()
+        if "metric" in params:
+            seen = []
+            for m in getp("metric").lower().split(","):
+                m = m.strip()
+                if m and m not in seen:
+                    seen.append(m)
+            c.metric = seen
+        if "tree_learner" in params:
+            tl = getp("tree_learner").lower()
+            if tl in ("serial", "feature", "data"):
+                c.tree_learner = tl
+            elif tl in ("feature_parallel",):
+                c.tree_learner = "feature"
+            elif tl in ("data_parallel",):
+                c.tree_learner = "data"
+            else:
+                log.fatal("Unknown tree learner type %s" % tl)
+
+        # IO
+        set_int("max_bin")
+        set_int("data_random_seed")
+        set_str("data")
+        if "valid_data" in params:
+            c.valid_data = [s.strip() for s in getp("valid_data").split(",") if s.strip()]
+        set_str("output_model")
+        set_str("output_result")
+        set_str("input_model")
+        set_int("verbose")
+        set_int("num_model_predict")
+        set_bool("is_pre_partition")
+        set_bool("is_enable_sparse")
+        set_bool("use_two_round_loading")
+        set_bool("is_save_binary_file")
+        set_bool("enable_load_from_binary_file")
+        set_int("bin_construct_sample_cnt")
+        set_bool("is_predict_leaf_index")
+        set_bool("is_predict_raw_score")
+        set_bool("has_header")
+        set_str("label_column")
+        set_str("weight_column")
+        set_str("group_column")
+        set_str("ignore_column")
+
+        # objective / metric
+        set_float("sigmoid")
+        if "label_gain" in params:
+            c.label_gain = [float(x) for x in getp("label_gain").split(",") if x.strip()]
+        set_int("max_position")
+        set_bool("is_unbalance")
+        set_int("num_class")
+        if "ndcg_eval_at" in params:
+            c.ndcg_eval_at = [int(x) for x in getp("ndcg_eval_at").split(",") if x.strip()]
+
+        # tree
+        set_int("min_data_in_leaf")
+        set_float("min_sum_hessian_in_leaf")
+        set_float("lambda_l1")
+        set_float("lambda_l2")
+        set_float("min_gain_to_split")
+        set_int("num_leaves")
+        set_int("feature_fraction_seed")
+        set_float("feature_fraction")
+        set_float("histogram_pool_size")
+        set_int("max_depth")
+
+        # boosting
+        set_int("metric_freq")
+        set_bool("is_training_metric")
+        set_int("num_iterations")
+        set_float("learning_rate")
+        set_float("bagging_fraction")
+        set_int("bagging_seed")
+        set_int("bagging_freq")
+        set_int("early_stopping_round")
+        set_float("drop_rate")
+        set_int("drop_seed")
+
+        # network
+        set_int("num_machines")
+        set_int("local_listen_port")
+        set_int("time_out")
+        set_str("machine_list_file")
+
+        # tpu
+        set_int("num_shards")
+        set_str("hist_dtype")
+        set_bool("donate_buffers")
+
+        c.check_param_conflict()
+        log.set_level_from_verbosity(c.verbose)
+        return c
+
+    def check_param_conflict(self) -> None:
+        # mirrors reference src/io/config.cpp:129-177
+        multiclass = self.objective == "multiclass"
+        if multiclass:
+            if self.num_class <= 1:
+                log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+        else:
+            if self.task == "train" and self.num_class != 1:
+                log.fatal("Number of classes must be 1 for non-multiclass training")
+        for m in self.metric:
+            m_multi = m in ("multi_logloss", "multi_error")
+            if (multiclass and not m_multi) or (not multiclass and m_multi):
+                log.fatal("Objective and metrics don't match")
+        # In the reference, num_machines>1 selects distributed training; on
+        # TPU a "machine" is a mesh shard, so num_machines>1 with serial
+        # learner collapses to serial (exactly as the reference does).
+        if self.num_machines > 1:
+            self.is_parallel = True
+        else:
+            self.is_parallel = False
+        if self.tree_learner == "serial":
+            self.is_parallel = False
+            self.num_machines = 1
+            self.is_parallel_find_bin = False
+        elif self.tree_learner == "feature":
+            self.is_parallel_find_bin = False
+        elif self.tree_learner == "data":
+            self.is_parallel = True
+            self.is_parallel_find_bin = True
+            if self.histogram_pool_size >= 0:
+                log.warning(
+                    "Histogram LRU queue was enabled (histogram_pool_size=%f). "
+                    "Will disable this to reduce communication costs" % self.histogram_pool_size)
+                self.histogram_pool_size = NO_LIMIT
+
+
+def apply_aliases(params: Dict[str, str]) -> Dict[str, str]:
+    out = dict(params)
+    for k, v in params.items():
+        canonical = ALIAS_TABLE.get(k)
+        if canonical is not None and canonical not in out:
+            out[canonical] = v
+    return out
+
+
+def parse_kv_line(line: str) -> Optional[tuple]:
+    line = line.split("#", 1)[0].strip()
+    if not line:
+        return None
+    parts = line.split("=", 1)
+    if len(parts) != 2:
+        return None
+    key = parts[0].strip().strip('"').strip("'")
+    val = parts[1].strip().strip('"').strip("'")
+    if not key:
+        return None
+    return key, val
+
+
+def load_parameters(argv: List[str]) -> Dict[str, str]:
+    """CLI args + optional config file; CLI wins.
+    Mirrors Application::LoadParameters (reference src/application/application.cpp:46-104)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        kv = parse_kv_line(arg)
+        if kv is None:
+            log.warning("Unknown parameter %s" % arg)
+            continue
+        cli[kv[0]] = kv[1]
+    params: Dict[str, str] = {}
+    config_file = cli.get("config") or cli.get("config_file")
+    if config_file:
+        with open(config_file, "r") as f:
+            for line in f:
+                kv = parse_kv_line(line)
+                if kv is not None:
+                    params.setdefault(kv[0], kv[1])
+    # CLI priority
+    params.update(cli)
+    return params

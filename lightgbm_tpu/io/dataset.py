@@ -1,0 +1,336 @@
+"""Binned dataset container and loader.
+
+TPU-native redesign of the reference io layer (src/io/dataset.cpp,
+src/io/dataset_loader.cpp, src/io/metadata.cpp):
+
+  - the training representation is a dense feature-major `[F, N]` uint8/16
+    bin matrix destined for HBM (sharded along N under pjit), instead of the
+    reference's per-feature Dense/Sparse/OrderedSparse bin objects.  Sparse
+    delta-encoding is deliberately dropped: 1 byte/value dense is cheap and
+    the TPU VPU/MXU gains nothing from skipping zeros (divergence documented
+    in SURVEY.md §7.1).
+  - binning (BinMapper) runs host-side at load; validation sets are binned
+    with the TRAIN mappers (Dataset::CopyFeatureMapperFrom, dataset.cpp:42-59).
+  - metadata sidecar files <data>.weight/.query/.init load like
+    Metadata::LoadWeights/LoadQueryBoundaries/LoadInitialScore
+    (src/io/metadata.cpp:252-327).
+  - the binary cache (`<file>.bin`, dataset_loader.cpp:852-869) is an .npz
+    with the same role (format itself is ours, not byte-compatible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+from ..config import Config
+from .binning import BinMapper, find_bin
+from .parser import parse_file_lines
+
+_BIN_CACHE_VERSION = 1
+
+
+@dataclasses.dataclass
+class Metadata:
+    """Labels / weights / query boundaries / init scores
+    (reference include/LightGBM/dataset.h:35-213)."""
+    label: np.ndarray                           # [N] f32
+    weights: Optional[np.ndarray] = None        # [N] f32
+    query_boundaries: Optional[np.ndarray] = None  # [num_queries + 1] i32
+    init_score: Optional[np.ndarray] = None     # [N * num_class] f64
+    query_weights: Optional[np.ndarray] = None  # [num_queries] f32
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def finish_queries(self) -> None:
+        """Compute per-query weights (reference metadata.cpp:329-343)."""
+        if self.query_boundaries is not None and self.weights is not None:
+            qb = self.query_boundaries
+            qw = np.zeros(len(qb) - 1, dtype=np.float32)
+            for i in range(len(qb) - 1):
+                qw[i] = self.weights[qb[i]:qb[i + 1]].sum() / max(qb[i + 1] - qb[i], 1)
+            self.query_weights = qw
+
+
+@dataclasses.dataclass
+class Dataset:
+    bins: np.ndarray                  # [F, N] uint8/uint16 feature-major
+    bin_mappers: List[BinMapper]      # per used feature
+    used_feature_map: np.ndarray      # [num_total_features] i32, -1 = unused
+    real_feature_index: np.ndarray    # [F] i32 inner -> original column
+    num_total_features: int
+    feature_names: List[str]
+    metadata: Metadata
+    label_idx: int = 0
+
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[1]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def max_num_bin(self) -> int:
+        return max((m.num_bin for m in self.bin_mappers), default=1)
+
+    def bin_feature_values(self, feats: np.ndarray) -> np.ndarray:
+        """Bin a raw [N, num_total_features] matrix with this dataset's
+        mappers -> [F, N]."""
+        n = feats.shape[0]
+        dtype = self.bins.dtype
+        out = np.zeros((self.num_features, n), dtype=dtype)
+        for inner, real in enumerate(self.real_feature_index):
+            col = feats[:, real] if real < feats.shape[1] else np.zeros(n)
+            out[inner] = self.bin_mappers[inner].value_to_bin(col).astype(dtype)
+        return out
+
+    def bin_upper_bounds_matrix(self) -> np.ndarray:
+        """[F, max_bin] f64 padded with +inf — device-side threshold lookup."""
+        b = self.max_num_bin
+        out = np.full((self.num_features, b), np.inf, dtype=np.float64)
+        for i, m in enumerate(self.bin_mappers):
+            out[i, :m.num_bin] = m.bin_upper_bound
+        return out
+
+
+def _parse_column_spec(spec: str, names: List[str]) -> int:
+    """index or `name:col` -> column index; -1 when unspecified."""
+    spec = spec.strip()
+    if not spec:
+        return -1
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if name not in names:
+            log.fatal("Column name %s not found" % name)
+        return names.index(name)
+    return int(spec)
+
+
+def _load_sidecar(path: str) -> Optional[np.ndarray]:
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        vals = [float(x) for x in f.read().split()]
+    return np.asarray(vals, dtype=np.float64)
+
+
+def load_dataset(filename: str, config: Config,
+                 reference: Optional[Dataset] = None,
+                 rank: int = 0, num_shards: int = 1) -> Dataset:
+    """Load a text data file into a binned Dataset.
+
+    reference: train Dataset whose bin mappers must be reused (valid data).
+    rank/num_shards: row sharding for distributed loading — each host keeps
+    rows r with r % num_shards == rank (reference dataset_loader.cpp:467-512
+    uses random assignment; modulo keeps determinism without an RNG sync).
+    """
+    cache = filename + ".bin"
+    if (reference is None and config.enable_load_from_binary_file
+            and os.path.isfile(cache) and num_shards == 1):
+        try:
+            return _load_binary(cache)
+        except Exception as e:  # corrupt/stale cache: fall through to text
+            log.warning("Failed to load binary cache %s: %s" % (cache, e))
+
+    with open(filename) as f:
+        lines = f.read().splitlines()
+    lines = [ln for ln in lines if ln.strip()]
+
+    names: List[str] = []
+    if config.has_header and lines:
+        first_sep = "\t" if "\t" in lines[0] else ","
+        names = lines[0].split(first_sep)
+        lines = lines[1:]
+
+    label_idx = _parse_column_spec(config.label_column, names)
+    if label_idx < 0:
+        label_idx = 0
+
+    label, feats, fmt = parse_file_lines(lines, label_idx)
+    n_total = len(label)
+
+    if num_shards > 1 and not config.is_pre_partition:
+        keep = np.arange(n_total) % num_shards == rank
+        label, feats = label[keep], feats[keep]
+
+    n = len(label)
+    ncols = feats.shape[1]
+
+    # weight / group columns (indices are original-column space; shift past
+    # the removed label column like the reference parsers do)
+    def shifted(idx):
+        if idx < 0:
+            return -1
+        return idx - 1 if idx > label_idx else idx
+
+    weight_idx = shifted(_parse_column_spec(config.weight_column, names))
+    group_idx = shifted(_parse_column_spec(config.group_column, names))
+
+    weights = None
+    query_boundaries = None
+    drop_cols = set()
+    if weight_idx >= 0:
+        weights = feats[:, weight_idx].astype(np.float32)
+        drop_cols.add(weight_idx)
+    if group_idx >= 0:
+        qid = feats[:, group_idx].astype(np.int64)
+        # per-row query ids -> boundaries (reference metadata.cpp:66-92)
+        change = np.nonzero(np.diff(qid))[0] + 1
+        query_boundaries = np.concatenate([[0], change, [n]]).astype(np.int32)
+        drop_cols.add(group_idx)
+
+    ignore = set()
+    if config.ignore_column:
+        spec = config.ignore_column
+        if spec.startswith("name:"):
+            for nm in spec[5:].split(","):
+                if nm in names:
+                    ignore.add(names.index(nm))
+        else:
+            ignore.update(int(x) for x in spec.split(",") if x.strip())
+
+    # sidecar files override/augment (reference metadata.cpp:252-327)
+    w = _load_sidecar(filename + ".weight")
+    if w is not None:
+        weights = w.astype(np.float32)
+        log.info("Loading weights...")
+    q = _load_sidecar(filename + ".query")
+    if q is not None:
+        counts = q.astype(np.int64)
+        query_boundaries = np.concatenate(
+            [[0], np.cumsum(counts)]).astype(np.int32)
+        log.info("Loading query boundaries...")
+    init = _load_sidecar(filename + ".init")
+
+    metadata = Metadata(label=label.astype(np.float32), weights=weights,
+                        query_boundaries=query_boundaries, init_score=init)
+    metadata.finish_queries()
+
+    if not names:
+        names = ["Column_%d" % i for i in range(ncols)]
+
+    if reference is not None:
+        ds = Dataset(
+            bins=np.zeros((reference.num_features, n), dtype=reference.bins.dtype),
+            bin_mappers=reference.bin_mappers,
+            used_feature_map=reference.used_feature_map,
+            real_feature_index=reference.real_feature_index,
+            num_total_features=reference.num_total_features,
+            feature_names=reference.feature_names,
+            metadata=metadata, label_idx=label_idx)
+        ds.bins = ds.bin_feature_values(feats)
+        return ds
+
+    # ---- find bins on a sample (bin_construct_sample_cnt rows) ----
+    sample_cnt = min(config.bin_construct_sample_cnt, n)
+    if sample_cnt < n:
+        rng = np.random.RandomState(config.data_random_seed)
+        sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+        sample = feats[sample_idx]
+    else:
+        sample = feats
+
+    mappers_all: List[Optional[BinMapper]] = []
+    for j in range(ncols):
+        if j in drop_cols or j in ignore:
+            mappers_all.append(None)
+            continue
+        mappers_all.append(find_bin(sample[:, j], sample.shape[0],
+                                    config.max_bin))
+
+    used_feature_map = np.full(ncols, -1, dtype=np.int32)
+    bin_mappers: List[BinMapper] = []
+    real_index: List[int] = []
+    for j, m in enumerate(mappers_all):
+        if m is None:
+            if j in ignore:
+                log.warning("Ignoring feature %s" % names[j])
+            continue
+        if m.is_trivial:
+            log.warning("Ignoring feature %s, only has one value" % names[j])
+            continue
+        used_feature_map[j] = len(bin_mappers)
+        bin_mappers.append(m)
+        real_index.append(j)
+
+    if not bin_mappers:
+        log.fatal("No usable features in data file %s" % filename)
+
+    max_bin_used = max(m.num_bin for m in bin_mappers)
+    dtype = np.uint8 if max_bin_used <= 256 else np.uint16
+    bins = np.zeros((len(bin_mappers), n), dtype=dtype)
+    for inner, real in enumerate(real_index):
+        bins[inner] = bin_mappers[inner].value_to_bin(feats[:, real]).astype(dtype)
+
+    ds = Dataset(bins=bins, bin_mappers=bin_mappers,
+                 used_feature_map=used_feature_map,
+                 real_feature_index=np.asarray(real_index, dtype=np.int32),
+                 num_total_features=ncols, feature_names=names,
+                 metadata=metadata, label_idx=label_idx)
+    log.info("Finished loading data file, use %d features with %d data"
+             % (ds.num_features, ds.num_data))
+
+    if config.is_save_binary_file and num_shards == 1:
+        _save_binary(ds, cache)
+    return ds
+
+
+def _save_binary(ds: Dataset, path: str) -> None:
+    arrs = dict(
+        version=np.int32(_BIN_CACHE_VERSION),
+        bins=ds.bins,
+        used_feature_map=ds.used_feature_map,
+        real_feature_index=ds.real_feature_index,
+        num_total_features=np.int32(ds.num_total_features),
+        label_idx=np.int32(ds.label_idx),
+        feature_names=np.asarray(ds.feature_names),
+        label=ds.metadata.label,
+        num_bins=np.asarray([m.num_bin for m in ds.bin_mappers], dtype=np.int32),
+        sparse_rates=np.asarray([m.sparse_rate for m in ds.bin_mappers]),
+    )
+    for i, m in enumerate(ds.bin_mappers):
+        arrs["bounds_%d" % i] = m.bin_upper_bound
+    if ds.metadata.weights is not None:
+        arrs["weights"] = ds.metadata.weights
+    if ds.metadata.query_boundaries is not None:
+        arrs["query_boundaries"] = ds.metadata.query_boundaries
+    if ds.metadata.init_score is not None:
+        arrs["init_score"] = ds.metadata.init_score
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrs)
+    log.info("Saved binary dataset cache to %s" % path)
+
+
+def _load_binary(path: str) -> Dataset:
+    z = np.load(path, allow_pickle=False)
+    if int(z["version"]) != _BIN_CACHE_VERSION:
+        raise ValueError("bin cache version mismatch")
+    num_bins = z["num_bins"]
+    sparse = z["sparse_rates"]
+    mappers = [BinMapper(bin_upper_bound=z["bounds_%d" % i],
+                         num_bin=int(num_bins[i]), is_trivial=False,
+                         sparse_rate=float(sparse[i]))
+               for i in range(len(num_bins))]
+    metadata = Metadata(
+        label=z["label"],
+        weights=z["weights"] if "weights" in z else None,
+        query_boundaries=z["query_boundaries"] if "query_boundaries" in z else None,
+        init_score=z["init_score"] if "init_score" in z else None)
+    metadata.finish_queries()
+    ds = Dataset(bins=z["bins"], bin_mappers=mappers,
+                 used_feature_map=z["used_feature_map"],
+                 real_feature_index=z["real_feature_index"],
+                 num_total_features=int(z["num_total_features"]),
+                 feature_names=[str(s) for s in z["feature_names"]],
+                 metadata=metadata, label_idx=int(z["label_idx"]))
+    log.info("Loaded binary dataset cache from %s (%d features, %d rows)"
+             % (path, ds.num_features, ds.num_data))
+    return ds

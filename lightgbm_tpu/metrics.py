@@ -1,0 +1,271 @@
+"""Evaluation metrics.
+
+Formula-parity ports of src/metric/* (reference), vectorized numpy:
+  - l1/l2 (l2 reports RMSE via the AverageLoss sqrt): regression_metric.hpp
+  - binary_logloss / binary_error (sigmoid inside Eval): binary_metric.hpp:18-143
+  - auc (weighted trapezoid with tie groups): binary_metric.hpp:148-256
+  - ndcg@k (all-negative queries count as 1): rank_metric.hpp + dcg_calculator.cpp
+  - multi_logloss / multi_error: multiclass_metric.hpp
+
+Metric display names (including the reference's quirky "name's : metric"
+prefix and NDCG trailing space) are reproduced so training logs diff
+cleanly against the reference CLI.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import Metadata
+from .objectives import default_label_gain, max_dcg_at_k
+from .utils import log
+
+K_EPSILON = 1e-15
+
+
+class Metric:
+    factor_to_bigger_better = -1.0
+
+    def init(self, test_name: str, metadata: Metadata, num_data: int) -> None:
+        self.metadata = metadata
+        self.num_data = num_data
+        self.weights = metadata.weights
+        self.sum_weights = (float(num_data) if self.weights is None
+                            else float(self.weights.sum()))
+        self.names: List[str] = []
+
+    def eval(self, score: np.ndarray) -> List[float]:
+        raise NotImplementedError
+
+
+class _RegressionMetric(Metric):
+    display = ""
+
+    def init(self, test_name, metadata, num_data):
+        super().init(test_name, metadata, num_data)
+        self.names = ["%s's : %s" % (test_name, self.display)]
+
+    def loss_on_point(self, label, score):
+        raise NotImplementedError
+
+    def average_loss(self, sum_loss, sum_weights):
+        return sum_loss / sum_weights
+
+    def eval(self, score):
+        label = self.metadata.label.astype(np.float64)
+        loss = self.loss_on_point(label, score.astype(np.float64))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [self.average_loss(float(loss.sum()), self.sum_weights)]
+
+
+class L2Metric(_RegressionMetric):
+    display = "l2 loss"
+
+    def loss_on_point(self, label, score):
+        return (score - label) ** 2
+
+    def average_loss(self, sum_loss, sum_weights):
+        return float(np.sqrt(sum_loss / sum_weights))
+
+
+class L1Metric(_RegressionMetric):
+    display = "l1 loss"
+
+    def loss_on_point(self, label, score):
+        return np.abs(score - label)
+
+
+class _BinaryMetric(Metric):
+    display = ""
+
+    def __init__(self, config: Config):
+        self.sigmoid = float(config.sigmoid)
+
+    def init(self, test_name, metadata, num_data):
+        super().init(test_name, metadata, num_data)
+        self.names = ["%s's : %s" % (test_name, self.display)]
+
+    def loss_on_point(self, label, prob):
+        raise NotImplementedError
+
+    def eval(self, score):
+        prob = 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid
+                                   * score.astype(np.float64)))
+        loss = self.loss_on_point(self.metadata.label.astype(np.float64), prob)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum()) / self.sum_weights]
+
+
+class BinaryLoglossMetric(_BinaryMetric):
+    display = "log loss"
+
+    def loss_on_point(self, label, prob):
+        p = np.where(label == 0, 1.0 - prob, prob)
+        return -np.log(np.maximum(p, K_EPSILON))
+
+
+class BinaryErrorMetric(_BinaryMetric):
+    display = "error rate"
+
+    def loss_on_point(self, label, prob):
+        return np.where(prob < 0.5, label, 1.0 - label)
+
+
+class AUCMetric(Metric):
+    factor_to_bigger_better = 1.0
+
+    def __init__(self, config: Config):
+        pass
+
+    def init(self, test_name, metadata, num_data):
+        super().init(test_name, metadata, num_data)
+        self.names = ["%s's : AUC" % test_name]
+
+    def eval(self, score):
+        """Weighted trapezoid with score-tie groups
+        (reference binary_metric.hpp:185-248)."""
+        s = score.astype(np.float64)
+        label = self.metadata.label.astype(np.float64)
+        w = (np.ones_like(label) if self.weights is None
+             else self.weights.astype(np.float64))
+        order = np.argsort(-s, kind="stable")
+        s, label, w = s[order], label[order], w[order]
+        pos = label * w
+        neg = (1.0 - label) * w
+        # group by equal scores
+        boundary = np.concatenate([[True], s[1:] != s[:-1]])
+        group = np.cumsum(boundary) - 1
+        ngroups = group[-1] + 1
+        gpos = np.bincount(group, weights=pos, minlength=ngroups)
+        gneg = np.bincount(group, weights=neg, minlength=ngroups)
+        cum_pos_before = np.concatenate([[0.0], np.cumsum(gpos)[:-1]])
+        accum = float((gneg * (gpos * 0.5 + cum_pos_before)).sum())
+        sum_pos = float(gpos.sum())
+        if sum_pos > 0 and sum_pos != self.sum_weights:
+            return [accum / (sum_pos * (self.sum_weights - sum_pos))]
+        return [1.0]
+
+
+class _MulticlassMetric(Metric):
+    display = ""
+
+    def __init__(self, config: Config):
+        self.num_class = config.num_class
+
+    def init(self, test_name, metadata, num_data):
+        super().init(test_name, metadata, num_data)
+        self.names = ["%s's : %s" % (test_name, self.display)]
+
+    def loss_on_point(self, label_int, prob):
+        raise NotImplementedError
+
+    def eval(self, score):
+        """score [K, N]."""
+        sc = score.astype(np.float64)
+        e = np.exp(sc - sc.max(axis=0, keepdims=True))
+        prob = e / e.sum(axis=0, keepdims=True)              # [K, N]
+        li = self.metadata.label.astype(np.int64)
+        loss = self.loss_on_point(li, prob)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return [float(loss.sum()) / self.sum_weights]
+
+
+class MultiLoglossMetric(_MulticlassMetric):
+    display = "multi logloss"
+
+    def loss_on_point(self, label_int, prob):
+        p = prob[label_int, np.arange(prob.shape[1])]
+        return -np.log(np.maximum(p, K_EPSILON))
+
+
+class MultiErrorMetric(_MulticlassMetric):
+    display = "multi error"
+
+    def loss_on_point(self, label_int, prob):
+        pred = prob.argmax(axis=0)
+        return (pred != label_int).astype(np.float64)
+
+
+class NDCGMetric(Metric):
+    factor_to_bigger_better = 1.0
+
+    def __init__(self, config: Config):
+        self.eval_at = sorted(config.ndcg_eval_at or [1, 2, 3, 4, 5])
+        self.label_gain = np.asarray(config.label_gain or default_label_gain(),
+                                     dtype=np.float64)
+        self.discount = 1.0 / np.log2(2.0 + np.arange(10000))
+
+    def init(self, test_name, metadata, num_data):
+        super().init(test_name, metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("The NDCG metric requires query information")
+        self.qb = metadata.query_boundaries
+        self.names = ["%s's : NDCG@%d " % (test_name, k) for k in self.eval_at]
+        nq = len(self.qb) - 1
+        # cache inverse max DCG per (query, k)
+        self.inv_max = np.zeros((nq, len(self.eval_at)))
+        for q in range(nq):
+            lab = metadata.label[self.qb[q]:self.qb[q + 1]]
+            for j, k in enumerate(self.eval_at):
+                m = max_dcg_at_k(k, lab, self.label_gain, self.discount)
+                self.inv_max[q, j] = 1.0 / m if m > 0 else -1.0
+        qw = metadata.query_weights
+        self.query_weights = qw
+        self.sum_query_weights = (float(nq) if qw is None else float(qw.sum()))
+
+    def eval(self, score):
+        s = score.astype(np.float64)
+        nq = len(self.qb) - 1
+        result = np.zeros(len(self.eval_at))
+        for q in range(nq):
+            a, b = int(self.qb[q]), int(self.qb[q + 1])
+            w = 1.0 if self.query_weights is None else float(self.query_weights[q])
+            lab = self.metadata.label[a:b].astype(np.int64)
+            order = np.argsort(-s[a:b], kind="stable")
+            gains = self.label_gain[lab[order]]
+            for j, k in enumerate(self.eval_at):
+                if self.inv_max[q, j] <= 0:
+                    # all-negative query counts as perfect (rank_metric.hpp:99)
+                    result[j] += w
+                else:
+                    kk = min(k, b - a)
+                    dcg = float((gains[:kk] * self.discount[:kk]).sum())
+                    result[j] += dcg * self.inv_max[q, j] * w
+        return list(result / self.sum_query_weights)
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    if name in ("l2", "mse", "regression"):
+        return L2Metric()
+    if name in ("l1", "mae"):
+        return L1Metric()
+    if name == "binary_logloss":
+        return BinaryLoglossMetric(config)
+    if name == "binary_error":
+        return BinaryErrorMetric(config)
+    if name == "auc":
+        return AUCMetric(config)
+    if name == "ndcg":
+        return NDCGMetric(config)
+    if name == "multi_logloss":
+        return MultiLoglossMetric(config)
+    if name == "multi_error":
+        return MultiErrorMetric(config)
+    if name in ("", "none", "null"):
+        return None
+    log.fatal("Unknown metric type %s" % name)
+
+
+def create_metrics(config: Config) -> List[Metric]:
+    out = []
+    for name in config.metric:
+        m = create_metric(name, config)
+        if m is not None:
+            out.append(m)
+    return out

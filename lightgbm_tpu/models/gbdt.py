@@ -1,0 +1,527 @@
+"""GBDT boosting driver.
+
+Python/JAX host loop replacing the reference's GBDT class
+(src/boosting/gbdt.cpp): per-iteration flow is gradients -> per-class tree
+growth (one fused device call per tree, ops/grow.py) -> score updates ->
+metrics/early-stopping.  Model text format is byte-compatible with
+GBDT::SaveModelToFile / LoadModelFromString (gbdt.cpp:351-456).
+
+Bagging (row- and query-granular reservoir sampling, gbdt.cpp:109-160) and
+feature_fraction (serial_tree_learner.cpp:140-147) reproduce the reference's
+mt19937 draw streams bit-exactly (utils/mt19937.py), enabling tree-identity
+parity tests with bagging enabled.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..metrics import Metric
+from ..objectives import Objective
+from ..ops.grow import grow_tree
+from ..ops.predict import predict_leaf_binned
+from ..ops.split import SplitParams, K_MIN_SCORE
+from ..utils import log
+from ..utils.mt19937 import Mt19937Random
+from .tree import Tree
+
+NO_LIMIT = -1
+
+
+class GBDT:
+    name = "gbdt"
+
+    def __init__(self, config: Config, train_data: Optional[Dataset],
+                 objective: Optional[Objective],
+                 training_metrics: Sequence[Metric] = ()):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.num_class = config.num_class
+        self.iter = 0
+        self.models: List[Tree] = []
+        self.num_used_model = 0
+        self.early_stopping_round = config.early_stopping_round
+        self.shrinkage_rate = config.learning_rate
+        self.training_metrics = list(training_metrics)
+        self.valid_data: List[Dataset] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_bins_dev: List[jax.Array] = []
+        self.valid_scores: List[np.ndarray] = []
+        self.best_iter: List[List[int]] = []
+        self.best_score: List[List[float]] = []
+        self.saved_upto = -1
+        self._model_file = None
+
+        # sigmoid only used for binary output transform (gbdt.cpp:60-65)
+        self.sigmoid = -1.0
+        if objective is not None and objective.name == "binary":
+            self.sigmoid = config.sigmoid
+
+        if train_data is None:
+            self.max_feature_idx = 0
+            self.label_idx = 0
+            return
+
+        n = train_data.num_data
+        self.num_data = n
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.label_idx = train_data.label_idx
+        self.dtype = jnp.float64 if config.hist_dtype == "float64" else jnp.float32
+
+        # device-resident training state
+        self.bins_dev = jnp.asarray(train_data.bins)       # [F, N]
+        self.scores = self._init_scores(train_data, n)     # [K, N] device
+        self.params = SplitParams(
+            min_data_in_leaf=config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            lambda_l1=config.lambda_l1,
+            lambda_l2=config.lambda_l2,
+            min_gain_to_split=config.min_gain_to_split)
+        self.max_bin = int(train_data.max_num_bin)
+
+        # bagging state (gbdt.cpp:70-79)
+        self.bagging_enabled = (config.bagging_fraction < 1.0
+                                and config.bagging_freq > 0)
+        self.bag_rng = Mt19937Random(config.bagging_seed)
+        self.bag_masks = [np.ones(n, dtype=bool) for _ in range(self.num_class)]
+        # per-class feature-fraction RNG, all seeded feature_fraction_seed
+        # (one TreeLearner per class in the reference, gbdt.cpp:38-45)
+        self.feat_rngs = [Mt19937Random(config.feature_fraction_seed)
+                          for _ in range(self.num_class)]
+
+    # ------------------------------------------------------------------
+    def _init_scores(self, data: Dataset, n: int) -> jax.Array:
+        k = self.num_class
+        if data.metadata.init_score is not None:
+            init = np.asarray(data.metadata.init_score, dtype=np.float32)
+            if init.size == n * k:
+                return jnp.asarray(init.reshape(k, n))
+            log.warning("init score size mismatch, ignoring")
+        return jnp.zeros((k, n), dtype=jnp.float32)
+
+    def add_valid_data(self, data: Dataset, metrics: Sequence[Metric]) -> None:
+        if self.iter > 0:
+            log.fatal("Cannot add validation data after training started")
+        self.valid_data.append(data)
+        self.valid_metrics.append(list(metrics))
+        self.valid_bins_dev.append(jnp.asarray(data.bins))
+        k = self.num_class
+        vn = data.num_data
+        if data.metadata.init_score is not None:
+            init = np.asarray(data.metadata.init_score, dtype=np.float32)
+            if init.size == vn * k:
+                self.valid_scores.append(init.reshape(k, vn).copy())
+            else:
+                self.valid_scores.append(np.zeros((k, vn), dtype=np.float32))
+        else:
+            self.valid_scores.append(np.zeros((k, vn), dtype=np.float32))
+        if self.early_stopping_round > 0:
+            self.best_iter.append([0] * len(metrics))
+            self.best_score.append([-np.inf] * len(metrics))
+
+    # ------------------------------------------------------------------
+    def _bagging(self, it: int, cls: int) -> None:
+        """GBDT::Bagging (gbdt.cpp:109-160): row- or query-granular
+        reservoir selection, drawing from the shared bagging stream."""
+        cfg = self.config
+        if not self.bagging_enabled or it % cfg.bagging_freq != 0:
+            return
+        md = self.train_data.metadata
+        n = self.num_data
+        if md.query_boundaries is None:
+            bag_cnt = int(cfg.bagging_fraction * n)
+            mask = self.bag_rng.split_mask(n, bag_cnt)
+        else:
+            qb = md.query_boundaries
+            nq = len(qb) - 1
+            bag_query_cnt = int(nq * cfg.bagging_fraction)
+            qmask = self.bag_rng.split_mask(nq, bag_query_cnt)
+            mask = np.zeros(n, dtype=bool)
+            for q in np.nonzero(qmask)[0]:
+                mask[qb[q]:qb[q + 1]] = True
+        self.bag_masks[cls] = mask
+        log.debug("Re-bagging, using %d data to train" % int(mask.sum()))
+
+    def _feature_mask(self, cls: int) -> np.ndarray:
+        f = self.train_data.num_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return np.ones(f, dtype=bool)
+        used_cnt = int(f * frac)
+        idx = self.feat_rngs[cls].sample(f, used_cnt)
+        mask = np.zeros(f, dtype=bool)
+        mask[idx] = True
+        return mask
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None,
+                       is_eval: bool = True) -> bool:
+        """One boosting iteration (gbdt.cpp:169-205). Returns True when
+        training must stop."""
+        cfg = self.config
+        if gradients is None or hessians is None:
+            grad, hess = self.objective.get_gradients(self._score_for_gradients())
+            if grad.ndim == 1:
+                grad = grad[None, :]
+                hess = hess[None, :]
+        else:
+            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                self.num_class, self.num_data)
+            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                self.num_class, self.num_data)
+
+        for cls in range(self.num_class):
+            self._bagging(self.iter, cls)
+            fmask = self._feature_mask(cls)
+            tree, stop = self._train_tree(grad[cls], hess[cls],
+                                          self.bag_masks[cls], fmask, cls)
+            if stop:
+                log.info("Stopped training because there are no more leafs "
+                         "that meet the split requirements.")
+                return True
+            self.models.append(tree)
+        self.iter += 1
+        self.num_used_model = len(self.models) // self.num_class
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def _train_tree(self, grad, hess, bag_mask, fmask, cls):
+        cfg = self.config
+        dev_tree, leaf_id = grow_tree(
+            self.bins_dev,
+            grad.astype(self.dtype), hess.astype(self.dtype),
+            jnp.asarray(bag_mask), jnp.asarray(fmask),
+            max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
+            params=self.params, max_depth=cfg.max_depth)
+        num_leaves = int(dev_tree.num_leaves)
+        if num_leaves <= 1:
+            return None, True
+
+        lr = self.shrinkage_rate
+        # train-score update: leaf_value[leaf_id] gather for ALL rows —
+        # covers both the reference's partition fast path and the
+        # out-of-bag traversal (gbdt.cpp:162-167, score_updater.hpp:44-68)
+        leaf_vals = dev_tree.leaf_value.astype(jnp.float32) * jnp.float32(lr)
+        self.scores = self.scores.at[cls].add(leaf_vals[leaf_id])
+
+        # validation scores via vectorized binned traversal
+        for i, vbins in enumerate(self.valid_bins_dev):
+            vleaf = predict_leaf_binned(dev_tree.split_feature,
+                                        dev_tree.threshold_bin,
+                                        dev_tree.left_child,
+                                        dev_tree.right_child, vbins)
+            self.valid_scores[i][cls] += np.asarray(leaf_vals)[np.asarray(vleaf)]
+
+        tree = self._to_host_tree(dev_tree, num_leaves)
+        tree.shrinkage(lr)
+        return tree, False
+
+    def _to_host_tree(self, dev_tree, num_leaves: int) -> Tree:
+        ds = self.train_data
+        nl = num_leaves
+        sf = np.asarray(dev_tree.split_feature)[:nl - 1]
+        tb = np.asarray(dev_tree.threshold_bin)[:nl - 1]
+        bounds = [ds.bin_mappers[f].bin_upper_bound for f in sf]
+        threshold = np.array([bounds[i][tb[i]] for i in range(nl - 1)],
+                             dtype=np.float64)
+        return Tree(
+            num_leaves=nl,
+            split_feature=sf.copy(),
+            split_feature_real=ds.real_feature_index[sf].astype(np.int32),
+            threshold_bin=tb.copy(),
+            threshold=threshold,
+            split_gain=np.asarray(dev_tree.split_gain, dtype=np.float64)[:nl - 1],
+            left_child=np.asarray(dev_tree.left_child)[:nl - 1],
+            right_child=np.asarray(dev_tree.right_child)[:nl - 1],
+            internal_value=np.asarray(dev_tree.internal_value,
+                                      dtype=np.float64)[:nl - 1],
+            leaf_parent=np.asarray(dev_tree.leaf_parent)[:nl],
+            leaf_value=np.asarray(dev_tree.leaf_value, dtype=np.float64)[:nl],
+            leaf_depth=np.asarray(dev_tree.leaf_depth)[:nl],
+            leaf_count=np.asarray(dev_tree.leaf_count)[:nl],
+        )
+
+    def _training_score(self):
+        s = self.scores
+        return s[0] if self.num_class == 1 else s
+
+    def _score_for_gradients(self):
+        """Score handed to the objective; DART drops trees here first
+        (GetTrainingScore override, dart.hpp:60-65)."""
+        return self._training_score()
+
+    # ------------------------------------------------------------------
+    def eval_and_check_early_stopping(self) -> bool:
+        stop = self.output_metric(self.iter)
+        if stop:
+            log.info("Early stopping at iteration %d, the best iteration "
+                     "round is %d" % (self.iter,
+                                      self.iter - self.early_stopping_round))
+            for _ in range(self.early_stopping_round * self.num_class):
+                self.models.pop()
+            self.num_used_model = len(self.models) // self.num_class
+        return stop
+
+    def output_metric(self, it: int) -> bool:
+        """GBDT::OutputMetric (gbdt.cpp:231-267)."""
+        cfg = self.config
+        ret = False
+        if it % cfg.metric_freq == 0:
+            train_score = np.asarray(self._training_score())
+            for metric in self.training_metrics:
+                for name, val in zip(metric.names, metric.eval(train_score)):
+                    log.info("Iteration: %d, %s : %f" % (it, name, val))
+        if it % cfg.metric_freq == 0 or self.early_stopping_round > 0:
+            for i in range(len(self.valid_metrics)):
+                vs = self.valid_scores[i]
+                score = vs[0] if self.num_class == 1 else vs
+                for j, metric in enumerate(self.valid_metrics[i]):
+                    vals = metric.eval(score)
+                    if it % cfg.metric_freq == 0:
+                        for name, val in zip(metric.names, vals):
+                            log.info("Iteration: %d, %s : %f" % (it, name, val))
+                    if not ret and self.early_stopping_round > 0:
+                        cur = metric.factor_to_bigger_better * vals[-1]
+                        if cur > self.best_score[i][j]:
+                            self.best_score[i][j] = cur
+                            self.best_iter[i][j] = it
+                        elif it - self.best_iter[i][j] >= self.early_stopping_round:
+                            ret = True
+        return ret
+
+    def get_eval_at(self, data_idx: int) -> List[float]:
+        if data_idx == 0:
+            score = np.asarray(self._training_score())
+            return [v for m in self.training_metrics for v in m.eval(score)]
+        i = data_idx - 1
+        vs = self.valid_scores[i]
+        score = vs[0] if self.num_class == 1 else vs
+        return [v for m in self.valid_metrics[i] for v in m.eval(score)]
+
+    # ------------------------------------------------------------------
+    # prediction over raw feature values (host path; the device path is
+    # ops/predict.predict_leaf_raw)
+    def predict_raw(self, x: np.ndarray) -> np.ndarray:
+        """x [N, num_total_features] -> [K, N] raw scores."""
+        k = self.num_class
+        n = x.shape[0]
+        out = np.zeros((k, n), dtype=np.float64)
+        nmodels = self.num_used_model * k
+        for i, tree in enumerate(self.models[:nmodels]):
+            out[i % k] += tree.predict(x)
+        return out
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        raw = self.predict_raw(x)
+        if self.sigmoid > 0:
+            return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * raw))
+        if self.num_class > 1:
+            e = np.exp(raw - raw.max(axis=0, keepdims=True))
+            return e / e.sum(axis=0, keepdims=True)
+        return raw
+
+    def predict_leaf_index(self, x: np.ndarray) -> np.ndarray:
+        k = self.num_class
+        nmodels = self.num_used_model * k
+        return np.stack([t.predict_leaf_index(x)
+                         for t in self.models[:nmodels]], axis=1)
+
+    def set_num_used_model(self, num: int) -> None:
+        if num >= 0:
+            self.num_used_model = min(num // self.num_class,
+                                      len(self.models) // self.num_class)
+
+    # ------------------------------------------------------------------
+    def save_model_to_file(self, num_used_model: int, is_finish: bool,
+                           filename: str) -> None:
+        """Incremental-append save (gbdt.cpp:351-400): holds back the last
+        early_stopping_round trees until finish."""
+        if self.saved_upto < 0:
+            self._model_file = open(filename, "w")
+            f = self._model_file
+            f.write(self.name + "\n")
+            f.write("num_class=%d\n" % self.num_class)
+            f.write("label_index=%d\n" % self.label_idx)
+            f.write("max_feature_idx=%d\n" % self.max_feature_idx)
+            if self.objective is not None:
+                f.write("objective=%s\n" % self.objective.name)
+            f.write("sigmoid=%g\n" % self.sigmoid)
+            f.write("\n")
+            self.saved_upto = 0
+        if self._model_file is None:
+            return
+        f = self._model_file
+        if num_used_model == NO_LIMIT:
+            num_used_model = len(self.models)
+        else:
+            num_used_model = num_used_model * self.num_class
+        rest = num_used_model - self.early_stopping_round * self.num_class
+        for i in range(self.saved_upto, rest):
+            f.write("Tree=%d\n" % i)
+            f.write(self.models[i].to_string() + "\n")
+        self.saved_upto = max(self.saved_upto, rest)
+        f.flush()
+        if is_finish:
+            for i in range(self.saved_upto, num_used_model):
+                f.write("Tree=%d\n" % i)
+                f.write(self.models[i].to_string() + "\n")
+            f.write("\n" + self.feature_importance() + "\n")
+            f.close()
+            self._model_file = None
+
+    def feature_importance(self) -> str:
+        """Split-count importances (gbdt.cpp:458-485)."""
+        imp = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
+        for tree in self.models:
+            for s in tree.split_feature_real[:tree.num_leaves - 1]:
+                imp[s] += 1
+        names = (self.train_data.feature_names if self.train_data is not None
+                 else ["Column_%d" % i for i in range(len(imp))])
+        pairs = [(imp[i], names[i]) for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[0])
+        out = ["", "feature importances:"]
+        out += ["%s=%d" % (name, cnt) for cnt, name in pairs]
+        return "\n".join(out) + "\n"
+
+    def load_model_from_string(self, model_str: str) -> None:
+        """GBDT::LoadModelFromString (gbdt.cpp:402-456)."""
+        lines = model_str.splitlines()
+
+        def find_line(prefix):
+            for ln in lines:
+                if prefix in ln:
+                    return ln
+            return ""
+
+        ln = find_line("num_class=")
+        if not ln:
+            log.fatal("Model file doesn't specify the number of classes")
+        self.num_class = int(ln.split("=")[1])
+        ln = find_line("label_index=")
+        if not ln:
+            log.fatal("Model file doesn't specify the label index")
+        self.label_idx = int(ln.split("=")[1])
+        ln = find_line("max_feature_idx=")
+        if not ln:
+            log.fatal("Model file doesn't specify max_feature_idx")
+        self.max_feature_idx = int(ln.split("=")[1])
+        ln = find_line("sigmoid=")
+        if ln:
+            self.sigmoid = float(ln.split("=")[1])
+
+        self.models = []
+        i = 0
+        while i < len(lines):
+            if lines[i].startswith("Tree="):
+                j = i + 1
+                while j < len(lines) and not lines[j].startswith("Tree="):
+                    j += 1
+                block = "\n".join(lines[i + 1:j])
+                if "num_leaves=" in block:
+                    self.models.append(Tree.from_string(block))
+                i = j
+            else:
+                i += 1
+        log.info("Finished loading %d models" % len(self.models))
+        self.num_used_model = len(self.models) // self.num_class
+
+
+class DART(GBDT):
+    """Dropout boosting (reference src/boosting/dart.hpp)."""
+    name = "dart"
+
+    def __init__(self, config: Config, train_data, objective,
+                 training_metrics=()):
+        super().__init__(config, train_data, objective, training_metrics)
+        self.drop_rate = config.drop_rate
+        self.drop_rng = Mt19937Random(config.drop_seed)
+        self.drop_index: List[int] = []
+
+    def _score_for_gradients(self):
+        self._dropping_trees()
+        return super()._training_score()
+
+    def train_one_iter(self, gradients=None, hessians=None,
+                       is_eval: bool = True) -> bool:
+        stopped = super().train_one_iter(gradients, hessians, False)
+        self._normalize()
+        if stopped:
+            return True
+        if is_eval:
+            return self.eval_and_check_early_stopping()
+        return False
+
+    def _add_tree_to_scores(self, tree: Tree, cls: int, scale: float,
+                            train: bool, valid: bool) -> None:
+        if train:
+            leaf = np.asarray(predict_leaf_binned(
+                jnp.asarray(tree.split_feature), jnp.asarray(tree.threshold_bin),
+                jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
+                self.bins_dev))
+            vals = (tree.leaf_value * scale).astype(np.float32)
+            self.scores = self.scores.at[cls].add(jnp.asarray(vals[leaf]))
+        if valid:
+            for i, vbins in enumerate(self.valid_bins_dev):
+                leaf = np.asarray(predict_leaf_binned(
+                    jnp.asarray(tree.split_feature),
+                    jnp.asarray(tree.threshold_bin),
+                    jnp.asarray(tree.left_child),
+                    jnp.asarray(tree.right_child), vbins))
+                self.valid_scores[i][cls] += \
+                    (tree.leaf_value * scale).astype(np.float32)[leaf]
+
+    def _dropping_trees(self) -> None:
+        """dart.hpp:86-110: drop trees from the train score, set shrinkage."""
+        self.drop_index = []
+        if self.drop_rate > 1e-15:
+            if self.iter > 0:
+                draws = self.drop_rng.next_doubles(self.iter)
+                self.drop_index = [i for i in range(self.iter)
+                                   if draws[i] < self.drop_rate]
+        if not self.drop_index and self.iter > 0:
+            self.drop_index = list(self.drop_rng.sample(self.iter, 1))
+        for i in self.drop_index:
+            for cls in range(self.num_class):
+                t = self.models[i * self.num_class + cls]
+                t.shrinkage(-1.0)
+                self._add_tree_to_scores(t, cls, 1.0, train=True, valid=False)
+        self.shrinkage_rate = 1.0 / (1.0 + len(self.drop_index))
+
+    def _normalize(self) -> None:
+        """dart.hpp:114-129."""
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for cls in range(self.num_class):
+                t = self.models[i * self.num_class + cls]
+                t.shrinkage(self.shrinkage_rate)
+                self._add_tree_to_scores(t, cls, 1.0, train=False, valid=True)
+                t.shrinkage(-k)
+                self._add_tree_to_scores(t, cls, 1.0, train=True, valid=False)
+
+    def save_model_to_file(self, num_used_model, is_finish, filename):
+        # DART only saves once training finished (dart.hpp:71-76)
+        if is_finish and self.saved_upto < 0:
+            super().save_model_to_file(num_used_model, is_finish, filename)
+
+
+def create_boosting(config: Config, train_data, objective,
+                    training_metrics=()) -> GBDT:
+    if config.boosting_type == "dart":
+        return DART(config, train_data, objective, training_metrics)
+    return GBDT(config, train_data, objective, training_metrics)
+
+
+def boosting_type_from_model_file(path: str) -> str:
+    """Sniff first line (reference src/boosting/boosting.cpp:7-16)."""
+    with open(path) as f:
+        first = f.readline().strip()
+    return first if first in ("gbdt", "dart") else "gbdt"

@@ -1,0 +1,133 @@
+"""Host-side tree model with reference-compatible text serialization.
+
+The on-device representation during growth is ops.grow.TreeArrays; this
+class is its host twin used for model IO and prediction bookkeeping.
+Text format is byte-compatible with Tree::ToString / Tree::Tree(str)
+(reference src/io/tree.cpp:105-176): same keys, same ordering, same
+6-significant-digit default ostream formatting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+
+def _fmt(x: float) -> str:
+    """C++ `ostream << double` default formatting (6 significant digits)."""
+    return "%g" % x
+
+
+def _fmt_arr(a) -> str:
+    return " ".join(_fmt(x) for x in a)
+
+
+def _fmt_int_arr(a) -> str:
+    return " ".join(str(int(x)) for x in a)
+
+
+@dataclasses.dataclass
+class Tree:
+    num_leaves: int
+    # node arrays [num_leaves - 1]
+    split_feature: np.ndarray        # inner (used-feature) index
+    split_feature_real: np.ndarray   # original column index
+    threshold_bin: np.ndarray
+    threshold: np.ndarray            # real-valued (bin upper bound)
+    split_gain: np.ndarray
+    left_child: np.ndarray
+    right_child: np.ndarray
+    internal_value: np.ndarray
+    # leaf arrays [num_leaves]
+    leaf_parent: np.ndarray
+    leaf_value: np.ndarray
+    leaf_depth: np.ndarray
+    leaf_count: np.ndarray
+
+    def shrinkage(self, rate: float) -> None:
+        """Tree::Shrinkage (reference include/LightGBM/tree.h:95-99)."""
+        self.leaf_value = self.leaf_value * rate
+
+    # ------------------------------------------------------------------
+    def to_string(self) -> str:
+        nl = self.num_leaves
+        lines = [
+            "num_leaves=%d" % nl,
+            "split_feature=" + _fmt_int_arr(self.split_feature_real[:nl - 1]),
+            "split_gain=" + _fmt_arr(self.split_gain[:nl - 1]),
+            "threshold=" + _fmt_arr(self.threshold[:nl - 1]),
+            "left_child=" + _fmt_int_arr(self.left_child[:nl - 1]),
+            "right_child=" + _fmt_int_arr(self.right_child[:nl - 1]),
+            "leaf_parent=" + _fmt_int_arr(self.leaf_parent[:nl]),
+            "leaf_value=" + _fmt_arr(self.leaf_value[:nl]),
+            "internal_value=" + _fmt_arr(self.internal_value[:nl - 1]),
+            "",
+        ]
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def from_string(s: str) -> "Tree":
+        kv = {}
+        for line in s.splitlines():
+            parts = line.split("=", 1)
+            if len(parts) == 2 and parts[0].strip() and parts[1].strip():
+                kv[parts[0].strip()] = parts[1].strip()
+        required = ("num_leaves", "split_feature", "split_gain", "threshold",
+                    "left_child", "right_child", "leaf_parent", "leaf_value",
+                    "internal_value")
+        for k in required:
+            if k not in kv:
+                raise ValueError("Tree model string format error: missing %s" % k)
+        nl = int(kv["num_leaves"])
+
+        def ints(key, cnt):
+            if cnt <= 0:
+                return np.zeros(0, np.int32)
+            return np.array(kv[key].split()[:cnt], dtype=np.int32)
+
+        def floats(key, cnt):
+            if cnt <= 0:
+                return np.zeros(0, np.float64)
+            return np.array(kv[key].split()[:cnt], dtype=np.float64)
+
+        sf = ints("split_feature", nl - 1)
+        return Tree(
+            num_leaves=nl,
+            split_feature=sf.copy(),       # inner==real when loaded from text
+            split_feature_real=sf,
+            threshold_bin=np.zeros(max(nl - 1, 0), dtype=np.int32),
+            threshold=floats("threshold", nl - 1),
+            split_gain=floats("split_gain", nl - 1),
+            left_child=ints("left_child", nl - 1),
+            right_child=ints("right_child", nl - 1),
+            internal_value=floats("internal_value", nl - 1),
+            leaf_parent=ints("leaf_parent", nl),
+            leaf_value=floats("leaf_value", nl),
+            leaf_depth=np.zeros(nl, dtype=np.int32),
+            leaf_count=np.zeros(nl, dtype=np.int32),
+        )
+
+    # ------------------------------------------------------------------
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Batch raw-feature prediction, [N, num_total_features] -> [N] f64.
+        Vectorized equivalent of Tree::GetLeaf (tree.h:179-189)."""
+        return self.leaf_value[self.predict_leaf_index(x)]
+
+    def predict_leaf_index(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        node = np.zeros(n, dtype=np.int64)
+        if self.num_leaves == 1:
+            return node
+        active = node >= 0
+        while active.any():
+            idx = node[active]
+            feat = self.split_feature_real[idx]
+            thr = self.threshold[idx]
+            val = x[active, feat]
+            nxt = np.where(val <= thr, self.left_child[idx],
+                           self.right_child[idx])
+            node[active] = nxt
+            active = node >= 0
+        return ~node

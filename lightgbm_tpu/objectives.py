@@ -1,0 +1,280 @@
+"""Objective functions: gradients/hessians of the training loss.
+
+Formula-parity ports (float32 math, like the reference's score_t=float):
+  - regression L2: reference src/objective/regression_objective.hpp:24-39
+  - binary logloss: reference src/objective/binary_objective.hpp:23-86
+  - multiclass softmax: reference src/objective/multiclass_objective.hpp:22-73
+  - lambdarank NDCG: reference src/objective/rank_objective.hpp:41-192,
+    including the 1M-entry sigmoid lookup table (same table, same index
+    math) so gradient values match the reference bit-for-bit on identical
+    scores.
+
+Elementwise objectives are jitted jnp; lambdarank is vectorized numpy over
+padded per-query blocks (scores are pulled to host once per iteration — the
+per-query pairwise O(L^2) work is tiny relative to tree growth).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+from .io.dataset import Metadata
+from .utils import log
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -np.inf
+
+
+class Objective:
+    name = "none"
+    num_class = 1
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.metadata = metadata
+        self.num_data = num_data
+
+    def get_gradients(self, score):
+        raise NotImplementedError
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        """Final transform for human-facing predictions."""
+        return score
+
+
+class RegressionL2(Objective):
+    name = "regression"
+
+    def __init__(self, config: Config):
+        pass
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        self.label = jnp.asarray(metadata.label, dtype=jnp.float32)
+        self.weights = (None if metadata.weights is None
+                        else jnp.asarray(metadata.weights, dtype=jnp.float32))
+
+    def get_gradients(self, score):
+        score = score.astype(jnp.float32)
+        grad = score - self.label
+        hess = jnp.ones_like(grad)
+        if self.weights is not None:
+            grad = grad * self.weights
+            hess = self.weights
+        return grad, hess
+
+
+class BinaryLogloss(Objective):
+    name = "binary"
+
+    def __init__(self, config: Config):
+        self.sigmoid = np.float32(config.sigmoid)
+        self.is_unbalance = config.is_unbalance
+        if self.sigmoid <= 0:
+            log.fatal("Sigmoid parameter %f should be greater than zero"
+                      % self.sigmoid)
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        labels01 = metadata.label.astype(np.int32)
+        cnt_pos = int((labels01 == 1).sum())
+        cnt_neg = num_data - cnt_pos
+        log.info("Number of postive: %d, number of negative: %d"
+                 % (cnt_pos, cnt_neg))
+        if cnt_pos == 0 or cnt_neg == 0:
+            log.fatal("Training data only contains one class")
+        w_pos, w_neg = 1.0, 1.0
+        if self.is_unbalance:
+            if cnt_pos > cnt_neg:
+                w_neg = cnt_pos / cnt_neg
+            else:
+                w_pos = cnt_neg / cnt_pos
+        sign = np.where(labels01 == 1, 1.0, -1.0).astype(np.float32)
+        lw = np.where(labels01 == 1, w_pos, w_neg).astype(np.float32)
+        if metadata.weights is not None:
+            lw = lw * metadata.weights.astype(np.float32)
+        self.sign = jnp.asarray(sign)
+        self.label_weight = jnp.asarray(lw)
+
+    def get_gradients(self, score):
+        score = score.astype(jnp.float32)
+        sig = jnp.float32(self.sigmoid)
+        response = (-2.0 * self.sign * sig
+                    / (1.0 + jnp.exp(2.0 * self.sign * sig * score)))
+        abs_r = jnp.abs(response)
+        grad = response * self.label_weight
+        hess = abs_r * (2.0 * sig - abs_r) * self.label_weight
+        return grad, hess
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-2.0 * float(self.sigmoid) * score))
+
+
+class MulticlassSoftmax(Objective):
+    name = "multiclass"
+
+    def __init__(self, config: Config):
+        self.num_class = config.num_class
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        li = metadata.label.astype(np.int32)
+        if li.min() < 0 or li.max() >= self.num_class:
+            log.fatal("Label must be in [0, %d)" % self.num_class)
+        self.onehot = jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[li].T)  # [K, N]
+        self.weights = (None if metadata.weights is None
+                        else jnp.asarray(metadata.weights, dtype=jnp.float32))
+
+    def get_gradients(self, score):
+        """score [K, N] -> grad/hess [K, N]."""
+        score = score.astype(jnp.float32)
+        p = jax.nn.softmax(score, axis=0)
+        grad = p - self.onehot
+        hess = 2.0 * p * (1.0 - p)
+        if self.weights is not None:
+            grad = grad * self.weights[None, :]
+            hess = hess * self.weights[None, :]
+        return grad, hess
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        e = np.exp(score - score.max(axis=0, keepdims=True))
+        return e / e.sum(axis=0, keepdims=True)
+
+
+_SIGMOID_BINS = 1024 * 1024
+
+
+class LambdarankNDCG(Objective):
+    name = "lambdarank"
+
+    def __init__(self, config: Config):
+        self.sigmoid = np.float32(config.sigmoid)
+        if self.sigmoid <= 0:
+            log.fatal("Sigmoid param %f should be greater than zero"
+                      % self.sigmoid)
+        self.label_gain = np.asarray(config.label_gain or default_label_gain(),
+                                     dtype=np.float32)
+        self.optimize_pos_at = config.max_position
+        # discount table (reference src/metric/dcg_calculator.cpp:27-30)
+        self.discount = (1.0 / np.log2(2.0 + np.arange(10000))).astype(np.float32)
+        # sigmoid lookup table (reference rank_objective.hpp:175-189)
+        self.min_in = np.float32(-50.0) / self.sigmoid / np.float32(2.0)
+        self.max_in = -self.min_in
+        self.idx_factor = np.float32(_SIGMOID_BINS / (self.max_in - self.min_in))
+        ts = (np.arange(_SIGMOID_BINS, dtype=np.float32) / self.idx_factor
+              + self.min_in)
+        self.sigmoid_table = (
+            np.float32(2.0) / (np.float32(1.0)
+                               + np.exp(np.float32(2.0) * ts * self.sigmoid)))
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("Lambdarank tasks require query information")
+        self.qb = metadata.query_boundaries
+        label = metadata.label
+        nq = len(self.qb) - 1
+        inv = np.zeros(nq, dtype=np.float32)
+        for q in range(nq):
+            lab = label[self.qb[q]:self.qb[q + 1]]
+            m = max_dcg_at_k(self.optimize_pos_at, lab, self.label_gain,
+                             self.discount)
+            inv[q] = 1.0 / m if m > 0 else m
+        self.inverse_max_dcgs = inv
+        self.weights = metadata.weights
+
+    def _sigmoid_lut(self, s: np.ndarray) -> np.ndarray:
+        idx = ((s - self.min_in) * self.idx_factor).astype(np.int64)
+        idx = np.clip(idx, 0, _SIGMOID_BINS - 1)
+        out = self.sigmoid_table[idx]
+        out = np.where(s <= self.min_in, self.sigmoid_table[0], out)
+        out = np.where(s >= self.max_in, self.sigmoid_table[-1], out)
+        return out
+
+    def get_gradients(self, score):
+        score_np = np.asarray(score, dtype=np.float32)
+        lambdas = np.zeros(self.num_data, dtype=np.float32)
+        hessians = np.zeros(self.num_data, dtype=np.float32)
+        label = self.metadata.label
+        for q in range(len(self.qb) - 1):
+            a, b = int(self.qb[q]), int(self.qb[q + 1])
+            self._one_query(score_np[a:b], label[a:b],
+                            self.inverse_max_dcgs[q],
+                            lambdas[a:b], hessians[a:b])
+        if self.weights is not None:
+            lambdas *= self.weights
+            hessians *= self.weights
+        return jnp.asarray(lambdas), jnp.asarray(hessians)
+
+    def _one_query(self, score, label, inv_max_dcg, lambdas, hessians):
+        """Vectorized pairwise lambdas for one query
+        (reference rank_objective.hpp:76-164)."""
+        cnt = len(score)
+        if cnt == 0 or inv_max_dcg <= 0:
+            return
+        order = np.argsort(-score, kind="stable")
+        rank_of = np.empty(cnt, dtype=np.int64)
+        rank_of[order] = np.arange(cnt)
+        best = score[order[0]]
+        worst_idx = cnt - 1
+        if worst_idx > 0 and score[order[worst_idx]] == K_MIN_SCORE:
+            worst_idx -= 1
+        worst = score[order[worst_idx]]
+
+        lab_i = label.astype(np.int64)
+        gain = self.label_gain[lab_i].astype(np.float32)     # [L]
+        disc = self.discount[rank_of].astype(np.float32)     # [L]
+
+        # pair (h, l): labels[h] > labels[l]
+        hi = lab_i[:, None] > lab_i[None, :]
+        valid = hi & (score[None, :] != K_MIN_SCORE) \
+                   & (score[:, None] != K_MIN_SCORE)
+        if not valid.any():
+            return
+        ds = (score[:, None] - score[None, :]).astype(np.float32)
+        dcg_gap = gain[:, None] - gain[None, :]
+        paired_disc = np.abs(disc[:, None] - disc[None, :])
+        delta = (dcg_gap * paired_disc * np.float32(inv_max_dcg))
+        if best != worst:
+            delta = delta / (np.float32(0.01) + np.abs(ds))
+        p_lambda = self._sigmoid_lut(ds)
+        p_hess = p_lambda * (np.float32(2.0) - p_lambda)
+        p_lambda = p_lambda * -delta
+        p_hess = p_hess * np.float32(2.0) * delta
+        p_lambda = np.where(valid, p_lambda, 0.0).astype(np.float32)
+        p_hess = np.where(valid, p_hess, 0.0).astype(np.float32)
+        lambdas += p_lambda.sum(axis=1) - p_lambda.sum(axis=0)
+        hessians += p_hess.sum(axis=1) + p_hess.sum(axis=0)
+
+
+def default_label_gain():
+    # 2^i - 1 (reference src/io/config.cpp:221-227)
+    return [0.0] + [float((1 << i) - 1) for i in range(1, 31)]
+
+
+def max_dcg_at_k(k: int, label: np.ndarray, label_gain: np.ndarray,
+                 discount: np.ndarray) -> float:
+    """DCGCalculator::CalMaxDCGAtK (reference dcg_calculator.cpp:34-57)."""
+    lab = np.sort(label.astype(np.int64))[::-1]
+    k = min(k, len(lab))
+    return float((label_gain[lab[:k]] * discount[:k]).sum())
+
+
+def create_objective(config: Config) -> Optional[Objective]:
+    t = config.objective
+    if t == "regression":
+        return RegressionL2(config)
+    if t == "binary":
+        return BinaryLogloss(config)
+    if t == "multiclass":
+        return MulticlassSoftmax(config)
+    if t == "lambdarank":
+        return LambdarankNDCG(config)
+    if t == "none":
+        return None
+    log.fatal("Unknown objective type %s" % t)

@@ -1,0 +1,236 @@
+"""Leaf-wise tree growth as one jitted `lax.while_loop`.
+
+TPU-native redesign of SerialTreeLearner::Train
+(reference src/treelearner/serial_tree_learner.cpp:100-134):
+
+  - The reference's DataPartition (grouped row-index arrays, re-shuffled at
+    every split) becomes a flat per-row `leaf_id [N] int32`, updated with one
+    vectorized compare per split — no data movement, shard-local under pjit.
+  - Per-leaf histogram cache (HistogramPool) becomes a dense
+    `hist [L, F, B, 3]` tensor; the parent-minus-smaller-child subtraction
+    trick (FeatureHistogram::Subtract, feature_histogram.hpp:97-106) is a
+    tensor subtract, halving histogram work exactly as in the reference.
+  - The whole `num_leaves - 1` split loop runs on-device inside one
+    compiled while_loop; host sees a single call per tree.
+
+Out-of-bag rows keep following splits via leaf_id (they are masked out of
+histograms by bag_mask); this makes the final score update a single
+`leaf_value[leaf_id]` gather for ALL rows, which is exactly equivalent to
+the reference's two-path update (partition fast path + OOB traversal,
+src/boosting/gbdt.cpp:162-167, score_updater.hpp:44-68).
+
+For data-parallel training, `psum_axis` names a mesh axis: local histograms
+and root sums are all-reduced over it (the moral equivalent of the
+reference's ReduceScatter of histogram buffers,
+src/treelearner/data_parallel_tree_learner.cpp:124-154), after which every
+shard computes the identical split — the same invariant the reference
+relies on (global counts, data_parallel_tree_learner.cpp:226-232).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import leaf_histogram, make_gvals
+from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
+                    leaf_output)
+
+
+class TreeArrays(NamedTuple):
+    """Array-based binary tree, mirroring reference include/LightGBM/tree.h:125-152.
+    Node slots [L-1]; leaves encoded as ~leaf_idx in child pointers."""
+    split_feature: jax.Array    # [L-1] i32 inner (used-feature) index
+    threshold_bin: jax.Array    # [L-1] i32
+    split_gain: jax.Array       # [L-1] f
+    left_child: jax.Array       # [L-1] i32
+    right_child: jax.Array      # [L-1] i32
+    leaf_parent: jax.Array      # [L] i32
+    leaf_value: jax.Array       # [L] f
+    internal_value: jax.Array   # [L-1] f
+    leaf_depth: jax.Array       # [L] i32
+    leaf_count: jax.Array       # [L] i32
+    num_leaves: jax.Array       # scalar i32
+
+
+class GrowState(NamedTuple):
+    tree: TreeArrays
+    leaf_id: jax.Array          # [N] i32
+    hist: jax.Array             # [L, F, B, 3]
+    leaf_sum_g: jax.Array       # [L]
+    leaf_sum_h: jax.Array       # [L]
+    best: BestSplit             # all fields [L]
+
+
+def _empty_tree(max_leaves: int, dtype) -> TreeArrays:
+    lm1 = max_leaves - 1
+    z_i = functools.partial(jnp.zeros, dtype=jnp.int32)
+    z_f = functools.partial(jnp.zeros, dtype=dtype)
+    return TreeArrays(
+        split_feature=z_i(lm1), threshold_bin=z_i(lm1), split_gain=z_f(lm1),
+        left_child=z_i(lm1), right_child=z_i(lm1),
+        leaf_parent=jnp.full(max_leaves, -1, dtype=jnp.int32),
+        leaf_value=z_f(max_leaves), internal_value=z_f(lm1),
+        leaf_depth=jnp.ones(max_leaves, dtype=jnp.int32),
+        leaf_count=z_i(max_leaves),
+        num_leaves=jnp.int32(1),
+    )
+
+
+def _empty_best(max_leaves: int, dtype) -> BestSplit:
+    z_i = functools.partial(jnp.zeros, dtype=jnp.int32)
+    z_f = functools.partial(jnp.zeros, dtype=dtype)
+    return BestSplit(
+        gain=jnp.full(max_leaves, K_MIN_SCORE, dtype=dtype),
+        feature=z_i(max_leaves), threshold=z_i(max_leaves),
+        left_count=z_i(max_leaves), right_count=z_i(max_leaves),
+        left_sum_g=z_f(max_leaves), left_sum_h=z_f(max_leaves),
+        right_sum_g=z_f(max_leaves), right_sum_h=z_f(max_leaves),
+        left_output=z_f(max_leaves), right_output=z_f(max_leaves),
+    )
+
+
+def _set_best(best: BestSplit, leaf, s: BestSplit) -> BestSplit:
+    return BestSplit(*[arr.at[leaf].set(v) for arr, v in zip(best, s)])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_leaves", "max_bin", "params", "max_depth",
+                     "row_chunk", "psum_axis"))
+def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
+              bag_mask: jax.Array, feature_mask: jax.Array, *,
+              max_leaves: int, max_bin: int, params: SplitParams,
+              max_depth: int = -1, row_chunk: int = 0,
+              psum_axis: Optional[str] = None):
+    """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
+
+    bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
+    feature_mask [F] bool. All per-split control flow is on-device.
+    """
+    f, n = bins_t.shape
+    dtype = grad.dtype
+
+    def psum(x):
+        return jax.lax.psum(x, psum_axis) if psum_axis else x
+
+    def hist_of(mask):
+        gv = make_gvals(grad, hess, mask, dtype)
+        return psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
+                                   row_chunk=row_chunk))
+
+    def depth_gated(gain, depth):
+        if max_depth > 0:
+            return jnp.where(depth >= max_depth, K_MIN_SCORE, gain)
+        return gain
+
+    # ---- root ----
+    root_hist = hist_of(bag_mask)
+    # every row lands in exactly one bin of feature 0, so its histogram sums
+    # are the root totals (LeafSplits::Init root sumup, leaf_splits.hpp:36-117)
+    root_g = jnp.sum(root_hist[0, :, 0])
+    root_h = jnp.sum(root_hist[0, :, 1])
+    root_cnt = jnp.round(jnp.sum(root_hist[0, :, 2])).astype(jnp.int32)
+
+    tree = _empty_tree(max_leaves, dtype)
+    tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_cnt))
+    best = _empty_best(max_leaves, dtype)
+    root_best = find_best_split(root_hist, root_cnt, root_g, root_h,
+                                feature_mask, params)
+    root_best = root_best._replace(
+        gain=depth_gated(root_best.gain, jnp.int32(1)))
+    best = _set_best(best, 0, root_best)
+
+    state = GrowState(
+        tree=tree,
+        leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        hist=jnp.zeros((max_leaves, f, max_bin, 3), dtype=dtype)
+            .at[0].set(root_hist),
+        leaf_sum_g=jnp.zeros(max_leaves, dtype=dtype).at[0].set(root_g),
+        leaf_sum_h=jnp.zeros(max_leaves, dtype=dtype).at[0].set(root_h),
+        best=best,
+    )
+
+    def cond(st: GrowState):
+        return ((st.tree.num_leaves < max_leaves)
+                & (jnp.max(st.best.gain) > 0.0))
+
+    def body(st: GrowState) -> GrowState:
+        tree, best = st.tree, st.best
+        # argmax over leaves; first max ⇒ smaller leaf index, matching
+        # ArrayArgs::ArgMax over best_split_per_leaf_ (serial_tree_learner.cpp:121)
+        bl = jnp.argmax(best.gain).astype(jnp.int32)
+        s = jax.tree_util.tree_map(lambda a: a[bl], best)
+
+        node = tree.num_leaves - 1
+        right = tree.num_leaves           # new leaf index
+        parent = tree.leaf_parent[bl]
+
+        # --- Tree::Split (reference src/io/tree.cpp:42-77) ---
+        pidx = jnp.maximum(parent, 0)
+        lc = tree.left_child
+        lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~bl),
+                                       node, lc[pidx]))
+        rc = tree.right_child
+        rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~bl),
+                                       node, rc[pidx]))
+        lc = lc.at[node].set(~bl)
+        rc = rc.at[node].set(~right)
+
+        new_tree = TreeArrays(
+            split_feature=tree.split_feature.at[node].set(s.feature),
+            threshold_bin=tree.threshold_bin.at[node].set(s.threshold),
+            split_gain=tree.split_gain.at[node].set(s.gain),
+            left_child=lc, right_child=rc,
+            leaf_parent=tree.leaf_parent.at[bl].set(node).at[right].set(node),
+            leaf_value=tree.leaf_value.at[bl].set(s.left_output)
+                                      .at[right].set(s.right_output),
+            internal_value=tree.internal_value.at[node].set(
+                tree.leaf_value[bl]),
+            leaf_depth=tree.leaf_depth
+                .at[right].set(tree.leaf_depth[bl] + 1)
+                .at[bl].add(1),
+            leaf_count=tree.leaf_count.at[bl].set(s.left_count)
+                                      .at[right].set(s.right_count),
+            num_leaves=tree.num_leaves + 1,
+        )
+
+        # --- partition: one vectorized compare (replaces DataPartition::Split,
+        # src/treelearner/data_partition.hpp:84-132) ---
+        binrow = bins_t[s.feature].astype(jnp.int32)
+        go_right = (st.leaf_id == bl) & (binrow > s.threshold)
+        leaf_id = jnp.where(go_right, right, st.leaf_id)
+
+        # --- histograms: smaller child scanned, larger by subtraction ---
+        left_is_smaller = s.left_count <= s.right_count
+        small_leaf = jnp.where(left_is_smaller, bl, right)
+        small_hist = hist_of((leaf_id == small_leaf) & bag_mask)
+        large_hist = st.hist[bl] - small_hist
+        left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_is_smaller, large_hist, small_hist)
+        hist = st.hist.at[bl].set(left_hist).at[right].set(right_hist)
+
+        leaf_sum_g = st.leaf_sum_g.at[bl].set(s.left_sum_g) \
+                                  .at[right].set(s.right_sum_g)
+        leaf_sum_h = st.leaf_sum_h.at[bl].set(s.left_sum_h) \
+                                  .at[right].set(s.right_sum_h)
+
+        # --- best splits for the two children ---
+        child_depth = new_tree.leaf_depth[bl]
+        lbest = find_best_split(left_hist, s.left_count, s.left_sum_g,
+                                s.left_sum_h, feature_mask, params)
+        lbest = lbest._replace(gain=depth_gated(lbest.gain, child_depth))
+        rbest = find_best_split(right_hist, s.right_count, s.right_sum_g,
+                                s.right_sum_h, feature_mask, params)
+        rbest = rbest._replace(gain=depth_gated(rbest.gain, child_depth))
+        best = _set_best(_set_best(best, bl, lbest), right, rbest)
+
+        return GrowState(tree=new_tree, leaf_id=leaf_id, hist=hist,
+                         leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
+                         best=best)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.tree, final.leaf_id

@@ -1,0 +1,67 @@
+"""Vectorized tree traversal.
+
+Replaces the reference's per-row pointer-chasing (Tree::GetLeaf,
+include/LightGBM/tree.h:166-189) with a data-parallel iterate: all rows step
+down one level per loop iteration via gathers — the loop is over tree depth,
+not over rows, so the work is [N]-wide vector ops that XLA maps onto the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def predict_leaf_binned(split_feature: jax.Array, threshold_bin: jax.Array,
+                        left_child: jax.Array, right_child: jax.Array,
+                        bins_t: jax.Array) -> jax.Array:
+    """Leaf index per row from binned features.
+
+    Mirrors Tree::GetLeaf over BinIterators (tree.h:166-177): node>=0 walks,
+    leaves are encoded ~leaf in the child arrays. Returns [N] i32 leaf ids.
+    """
+    n = bins_t.shape[1]
+    node = jnp.zeros(n, dtype=jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        idx = jnp.maximum(node, 0)
+        feat = split_feature[idx]
+        thr = threshold_bin[idx]
+        val = bins_t[feat, jnp.arange(n)].astype(jnp.int32)
+        nxt = jnp.where(val <= thr, left_child[idx], right_child[idx])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node)
+    return ~node
+
+
+@jax.jit
+def predict_leaf_raw(split_feature_real: jax.Array, threshold: jax.Array,
+                     left_child: jax.Array, right_child: jax.Array,
+                     x: jax.Array) -> jax.Array:
+    """Leaf index per row from raw feature values (Tree::GetLeaf, tree.h:179-189).
+
+    x: [N, F_total] float; split rule `value <= threshold` goes left.
+    """
+    n = x.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        idx = jnp.maximum(node, 0)
+        feat = split_feature_real[idx]
+        thr = threshold[idx]
+        val = x[jnp.arange(n), feat]
+        nxt = jnp.where(val <= thr, left_child[idx], right_child[idx])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node)
+    return ~node

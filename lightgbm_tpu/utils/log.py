@@ -1,0 +1,63 @@
+"""Logging utilities.
+
+TPU-native re-design of the reference's printf logger
+(/root/reference/include/LightGBM/utils/log.h) — same level semantics
+(Fatal raises), same user-facing message prefix so log-diffing against the
+reference CLI is possible.
+"""
+
+from __future__ import annotations
+
+import sys
+
+DEBUG = 2
+INFO = 1
+WARNING = 0
+FATAL = -1
+
+_level = INFO
+
+
+class LightGBMError(RuntimeError):
+    pass
+
+
+def reset_log_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def set_level_from_verbosity(verbosity: int) -> None:
+    # mirrors OverallConfig::Set verbosity mapping (reference src/io/config.cpp:52-63)
+    if verbosity == 1:
+        reset_log_level(INFO)
+    elif verbosity == 0:
+        reset_log_level(WARNING)
+    elif verbosity >= 2:
+        reset_log_level(DEBUG)
+    else:
+        reset_log_level(FATAL)
+
+
+def debug(msg: str, *args) -> None:
+    if _level >= DEBUG:
+        _write("Debug", msg % args if args else msg)
+
+
+def info(msg: str, *args) -> None:
+    if _level >= INFO:
+        _write("Info", msg % args if args else msg)
+
+
+def warning(msg: str, *args) -> None:
+    if _level >= WARNING:
+        _write("Warning", msg % args if args else msg)
+
+
+def fatal(msg: str, *args) -> None:
+    raise LightGBMError(msg % args if args else msg)
+
+
+def _write(level_str: str, msg: str) -> None:
+    sys.stdout.write("[LightGBM] [%s] %s\n" % (level_str, msg))
+    sys.stdout.flush()

@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+import so multi-chip sharding tests run anywhere, and enable x64 so parity
+tests can accumulate histograms in double like the reference."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+REFERENCE_DIR = "/root/reference"
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
