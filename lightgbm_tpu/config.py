@@ -178,6 +178,7 @@ class Config:
     # -- TPU-native additions --------------------------------------------
     num_shards: int = 0                   # 0 = all visible devices when tree_learner=data
     hist_dtype: str = "float32"           # histogram accumulator dtype
+    hist_impl: str = "auto"               # auto | xla | pallas
     donate_buffers: bool = True
 
     # ---------------------------------------------------------------------
@@ -309,7 +310,14 @@ class Config:
         # tpu
         set_int("num_shards")
         set_str("hist_dtype")
+        set_str("hist_impl")
         set_bool("donate_buffers")
+        if c.hist_impl not in ("auto", "xla", "pallas"):
+            log.fatal("Unknown hist_impl %s (expect auto|xla|pallas)"
+                      % c.hist_impl)
+        if c.hist_dtype not in ("float32", "float64"):
+            log.fatal("Unknown hist_dtype %s (expect float32|float64)"
+                      % c.hist_dtype)
 
         c.check_param_conflict()
         log.set_level_from_verbosity(c.verbose)

@@ -46,7 +46,8 @@ class _RegressionMetric(Metric):
 
     def init(self, test_name, metadata, num_data):
         super().init(test_name, metadata, num_data)
-        self.names = ["%s's : %s" % (test_name, self.display)]
+        # regression names have no "'s" (reference regression_metric.hpp:28)
+        self.names = ["%s : %s" % (test_name, self.display)]
 
     def loss_on_point(self, label, score):
         raise NotImplementedError
@@ -159,7 +160,8 @@ class _MulticlassMetric(Metric):
 
     def init(self, test_name, metadata, num_data):
         super().init(test_name, metadata, num_data)
-        self.names = ["%s's : %s" % (test_name, self.display)]
+        # multiclass names have no "'s" (reference multiclass_metric.hpp:28)
+        self.names = ["%s : %s" % (test_name, self.display)]
 
     def loss_on_point(self, label_int, prob):
         raise NotImplementedError

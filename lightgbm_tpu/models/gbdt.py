@@ -76,9 +76,6 @@ class GBDT:
         self.label_idx = train_data.label_idx
         self.dtype = jnp.float64 if config.hist_dtype == "float64" else jnp.float32
 
-        # device-resident training state
-        self.bins_dev = jnp.asarray(train_data.bins)       # [F, N]
-        self.scores = self._init_scores(train_data, n)     # [K, N] device
         self.params = SplitParams(
             min_data_in_leaf=config.min_data_in_leaf,
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
@@ -87,11 +84,72 @@ class GBDT:
             min_gain_to_split=config.min_gain_to_split)
         self.max_bin = int(train_data.max_num_bin)
 
-        # bagging state (gbdt.cpp:70-79)
+        # histogram implementation: the Pallas radix kernel is the TPU fast
+        # path (f32, uint8 bins, <=256 bins); XLA one-hot elsewhere
+        impl = config.hist_impl
+        if impl == "auto":
+            on_accel = jax.devices()[0].platform != "cpu"
+            impl = ("pallas" if (on_accel and self.max_bin <= 256
+                                 and self.dtype == jnp.float32
+                                 and train_data.bins.dtype == np.uint8)
+                    else "xla")
+        self.hist_impl = impl
+        row_unit = 1
+        if impl == "pallas":
+            # import lazily: XLA-only installs never touch Pallas
+            from ..ops.hist_pallas import PALLAS_ROW_BLOCK
+            if self.max_bin > 256:
+                log.fatal("hist_impl=pallas requires max_bin <= 256 "
+                          "(got %d); use hist_impl=xla" % self.max_bin)
+            if self.dtype != jnp.float32:
+                log.fatal("hist_impl=pallas accumulates in float32; "
+                          "hist_dtype=%s is incompatible" % config.hist_dtype)
+            if train_data.bins.dtype != np.uint8:
+                log.fatal("hist_impl=pallas requires uint8 bins")
+            row_unit = PALLAS_ROW_BLOCK
+
+        # data-parallel: shard rows over a device mesh (parallel/mesh.py),
+        # replacing the reference's socket/MPI histogram reduce-scatter.
+        # Rows are padded so each shard's slice is a multiple of the Pallas
+        # row block; padded rows are permanently out-of-bag.
+        self.grower = None
+        if config.tree_learner == "data":
+            from ..parallel.mesh import ShardedGrower, make_mesh
+            mesh = make_mesh(config.num_shards)
+            self.grower = ShardedGrower(
+                mesh, max_leaves=max(config.num_leaves, 2),
+                max_bin=self.max_bin, params=self.params,
+                max_depth=config.max_depth, hist_impl=impl)
+            row_unit *= self.grower.num_shards
+        self.n_pad = ((n + row_unit - 1) // row_unit) * row_unit
+
+        bins = train_data.bins
+        if self.n_pad != n:
+            bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
+        self.scores = self._init_scores(train_data, n)
+        if self.n_pad != n:
+            self.scores = jnp.pad(self.scores,
+                                  ((0, 0), (0, self.n_pad - n)))
+        if self.grower is not None:
+            self.bins_dev = jax.device_put(bins, self.grower.bins_sharding())
+            self.scores = jax.device_put(self.scores,
+                                         self.grower.row_sharding_2d())
+        else:
+            self.bins_dev = jnp.asarray(bins)
+        if objective is not None and self.n_pad != n:
+            objective.pad_to(self.n_pad)
+
+        # bagging state (gbdt.cpp:70-79); padded rows stay False forever
         self.bagging_enabled = (config.bagging_fraction < 1.0
                                 and config.bagging_freq > 0)
         self.bag_rng = Mt19937Random(config.bagging_seed)
-        self.bag_masks = [np.ones(n, dtype=bool) for _ in range(self.num_class)]
+        self.bag_masks = []
+        for _ in range(self.num_class):
+            m = np.zeros(self.n_pad, dtype=bool)
+            m[:n] = True
+            self.bag_masks.append(m)
+        # sharded/device bag masks are cached; _bagging invalidates
+        self._bag_dev = [None] * self.num_class
         # per-class feature-fraction RNG, all seeded feature_fraction_seed
         # (one TreeLearner per class in the reference, gbdt.cpp:38-45)
         self.feat_rngs = [Mt19937Random(config.feature_fraction_seed)
@@ -147,7 +205,10 @@ class GBDT:
             mask = np.zeros(n, dtype=bool)
             for q in np.nonzero(qmask)[0]:
                 mask[qb[q]:qb[q + 1]] = True
-        self.bag_masks[cls] = mask
+        padded = np.zeros(self.n_pad, dtype=bool)
+        padded[:n] = mask
+        self.bag_masks[cls] = padded
+        self._bag_dev[cls] = None
         log.debug("Re-bagging, using %d data to train" % int(mask.sum()))
 
     def _feature_mask(self, cls: int) -> np.ndarray:
@@ -177,12 +238,16 @@ class GBDT:
                 self.num_class, self.num_data)
             hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
                 self.num_class, self.num_data)
+            if self.n_pad != self.num_data:
+                pad = ((0, 0), (0, self.n_pad - self.num_data))
+                grad = jnp.pad(grad, pad)
+                hess = jnp.pad(hess, pad)
 
         for cls in range(self.num_class):
             self._bagging(self.iter, cls)
             fmask = self._feature_mask(cls)
             tree, stop = self._train_tree(grad[cls], hess[cls],
-                                          self.bag_masks[cls], fmask, cls)
+                                          self._bag_mask_dev(cls), fmask, cls)
             if stop:
                 log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
@@ -194,14 +259,30 @@ class GBDT:
             return self.eval_and_check_early_stopping()
         return False
 
-    def _train_tree(self, grad, hess, bag_mask, fmask, cls):
+    def _bag_mask_dev(self, cls: int):
+        """Device/sharded bag mask, uploaded only when bagging changed it."""
+        if self._bag_dev[cls] is None:
+            mask = self.bag_masks[cls]
+            if self.grower is not None:
+                self._bag_dev[cls] = self.grower.shard_rows(mask, self.n_pad)
+            else:
+                self._bag_dev[cls] = jnp.asarray(mask)
+        return self._bag_dev[cls]
+
+    def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
-        dev_tree, leaf_id = grow_tree(
-            self.bins_dev,
-            grad.astype(self.dtype), hess.astype(self.dtype),
-            jnp.asarray(bag_mask), jnp.asarray(fmask),
-            max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
-            params=self.params, max_depth=cfg.max_depth)
+        if self.grower is not None:
+            dev_tree, leaf_id = self.grower.grow(
+                self.bins_dev, grad.astype(self.dtype),
+                hess.astype(self.dtype), bag_mask_dev, jnp.asarray(fmask))
+        else:
+            dev_tree, leaf_id = grow_tree(
+                self.bins_dev,
+                grad.astype(self.dtype), hess.astype(self.dtype),
+                bag_mask_dev, jnp.asarray(fmask),
+                max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
+                params=self.params, max_depth=cfg.max_depth,
+                hist_impl=self.hist_impl)
         num_leaves = int(dev_tree.num_leaves)
         if num_leaves <= 1:
             return None, True
@@ -209,8 +290,10 @@ class GBDT:
         lr = self.shrinkage_rate
         # train-score update: leaf_value[leaf_id] gather for ALL rows —
         # covers both the reference's partition fast path and the
-        # out-of-bag traversal (gbdt.cpp:162-167, score_updater.hpp:44-68)
-        leaf_vals = dev_tree.leaf_value.astype(jnp.float32) * jnp.float32(lr)
+        # out-of-bag traversal (gbdt.cpp:162-167, score_updater.hpp:44-68).
+        # Shrinkage multiplies in the hist dtype BEFORE the f32 cast, like
+        # the reference's double leaf_value * rate then score_t cast.
+        leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
         self.scores = self.scores.at[cls].add(leaf_vals[leaf_id])
 
         # validation scores via vectorized binned traversal
@@ -251,13 +334,15 @@ class GBDT:
         )
 
     def _training_score(self):
-        s = self.scores
+        s = self.scores[:, :self.num_data]
         return s[0] if self.num_class == 1 else s
 
     def _score_for_gradients(self):
-        """Score handed to the objective; DART drops trees here first
-        (GetTrainingScore override, dart.hpp:60-65)."""
-        return self._training_score()
+        """Padded scores handed to the objective (which is itself padded via
+        pad_to, so no per-iteration slice/pad resharding round-trips); DART
+        drops trees here first (GetTrainingScore override, dart.hpp:60-65)."""
+        s = self.scores
+        return s[0] if self.num_class == 1 else s
 
     # ------------------------------------------------------------------
     def eval_and_check_early_stopping(self) -> bool:
@@ -448,7 +533,7 @@ class DART(GBDT):
 
     def _score_for_gradients(self):
         self._dropping_trees()
-        return super()._training_score()
+        return super()._score_for_gradients()
 
     def train_one_iter(self, gradients=None, hessians=None,
                        is_eval: bool = True) -> bool:

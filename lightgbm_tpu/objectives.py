@@ -37,6 +37,21 @@ class Objective:
     def init(self, metadata: Metadata, num_data: int) -> None:
         self.metadata = metadata
         self.num_data = num_data
+        self.n_pad = num_data
+
+    def pad_to(self, n_pad: int) -> None:
+        """Extend label-derived device arrays to a padded row count so
+        gradients can be computed directly on padded/sharded score arrays
+        (padded rows produce values that are masked out of histograms by
+        bag_mask and harmless in score updates)."""
+        self.n_pad = n_pad
+
+    @staticmethod
+    def _pad(arr, n_pad, value=0.0):
+        if arr is None or arr.shape[-1] >= n_pad:
+            return arr
+        pad = [(0, 0)] * (arr.ndim - 1) + [(0, n_pad - arr.shape[-1])]
+        return jnp.pad(arr, pad, constant_values=value)
 
     def get_gradients(self, score):
         raise NotImplementedError
@@ -57,6 +72,11 @@ class RegressionL2(Objective):
         self.label = jnp.asarray(metadata.label, dtype=jnp.float32)
         self.weights = (None if metadata.weights is None
                         else jnp.asarray(metadata.weights, dtype=jnp.float32))
+
+    def pad_to(self, n_pad: int) -> None:
+        super().pad_to(n_pad)
+        self.label = self._pad(self.label, n_pad)
+        self.weights = self._pad(self.weights, n_pad)
 
     def get_gradients(self, score):
         score = score.astype(jnp.float32)
@@ -100,6 +120,12 @@ class BinaryLogloss(Objective):
         self.sign = jnp.asarray(sign)
         self.label_weight = jnp.asarray(lw)
 
+    def pad_to(self, n_pad: int) -> None:
+        super().pad_to(n_pad)
+        # sign 0 + weight 0 -> zero grad/hess for padded rows
+        self.sign = self._pad(self.sign, n_pad)
+        self.label_weight = self._pad(self.label_weight, n_pad)
+
     def get_gradients(self, score):
         score = score.astype(jnp.float32)
         sig = jnp.float32(self.sigmoid)
@@ -129,6 +155,11 @@ class MulticlassSoftmax(Objective):
             np.eye(self.num_class, dtype=np.float32)[li].T)  # [K, N]
         self.weights = (None if metadata.weights is None
                         else jnp.asarray(metadata.weights, dtype=jnp.float32))
+
+    def pad_to(self, n_pad: int) -> None:
+        super().pad_to(n_pad)
+        self.onehot = self._pad(self.onehot, n_pad)
+        self.weights = self._pad(self.weights, n_pad)
 
     def get_gradients(self, score):
         """score [K, N] -> grad/hess [K, N]."""
@@ -198,8 +229,9 @@ class LambdarankNDCG(Objective):
 
     def get_gradients(self, score):
         score_np = np.asarray(score, dtype=np.float32)
-        lambdas = np.zeros(self.num_data, dtype=np.float32)
-        hessians = np.zeros(self.num_data, dtype=np.float32)
+        # padded rows (beyond the last query boundary) stay zero
+        lambdas = np.zeros(self.n_pad, dtype=np.float32)
+        hessians = np.zeros(self.n_pad, dtype=np.float32)
         label = self.metadata.label
         for q in range(len(self.qb) - 1):
             a, b = int(self.qb[q]), int(self.qb[q + 1])
@@ -207,8 +239,8 @@ class LambdarankNDCG(Objective):
                             self.inverse_max_dcgs[q],
                             lambdas[a:b], hessians[a:b])
         if self.weights is not None:
-            lambdas *= self.weights
-            hessians *= self.weights
+            lambdas[:self.num_data] *= self.weights
+            hessians[:self.num_data] *= self.weights
         return jnp.asarray(lambdas), jnp.asarray(hessians)
 
     def _one_query(self, score, label, inv_max_dcg, lambdas, hessians):

@@ -100,16 +100,18 @@ def _set_best(best: BestSplit, leaf, s: BestSplit) -> BestSplit:
 @functools.partial(
     jax.jit,
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
-                     "row_chunk", "psum_axis"))
+                     "row_chunk", "psum_axis", "hist_impl"))
 def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               bag_mask: jax.Array, feature_mask: jax.Array, *,
               max_leaves: int, max_bin: int, params: SplitParams,
               max_depth: int = -1, row_chunk: int = 0,
-              psum_axis: Optional[str] = None):
+              psum_axis: Optional[str] = None, hist_impl: str = "xla"):
     """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
 
     bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
     feature_mask [F] bool. All per-split control flow is on-device.
+    hist_impl: "xla" (portable one-hot matmul) or "pallas" (TPU radix
+    kernel, f32, max_bin<=256, N % 8192 == 0).
     """
     f, n = bins_t.shape
     dtype = grad.dtype
@@ -117,10 +119,22 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     def psum(x):
         return jax.lax.psum(x, psum_axis) if psum_axis else x
 
-    def hist_of(mask):
-        gv = make_gvals(grad, hess, mask, dtype)
-        return psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
-                                   row_chunk=row_chunk))
+    if hist_impl == "pallas":
+        from .hist_pallas import leaf_histogram_masked, make_gh8
+        gh8 = make_gh8(grad, hess)
+        bag_i32 = bag_mask.astype(jnp.int32)
+        # TPU runs the compiled kernel; CPU (tests) uses interpret mode
+        interpret = jax.default_backend() == "cpu"
+
+        def hist_leaf(leaf_id, target):
+            return psum(leaf_histogram_masked(
+                bins_t, gh8, leaf_id, bag_i32, target,
+                max_bin=max_bin, interpret=interpret).astype(dtype))
+    else:
+        def hist_leaf(leaf_id, target):
+            gv = make_gvals(grad, hess, (leaf_id == target) & bag_mask, dtype)
+            return psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
+                                       row_chunk=row_chunk))
 
     def depth_gated(gain, depth):
         if max_depth > 0:
@@ -128,7 +142,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         return gain
 
     # ---- root ----
-    root_hist = hist_of(bag_mask)
+    root_hist = hist_leaf(jnp.zeros(n, dtype=jnp.int32), jnp.int32(0))
     # every row lands in exactly one bin of feature 0, so its histogram sums
     # are the root totals (LeafSplits::Init root sumup, leaf_splits.hpp:36-117)
     root_g = jnp.sum(root_hist[0, :, 0])
@@ -207,7 +221,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         # --- histograms: smaller child scanned, larger by subtraction ---
         left_is_smaller = s.left_count <= s.right_count
         small_leaf = jnp.where(left_is_smaller, bl, right)
-        small_hist = hist_of((leaf_id == small_leaf) & bag_mask)
+        small_hist = hist_leaf(leaf_id, small_leaf)
         large_hist = st.hist[bl] - small_hist
         left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_is_smaller, large_hist, small_hist)

@@ -1,6 +1,11 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-import so multi-chip sharding tests run anywhere, and enable x64 so parity
-tests can accumulate histograms in double like the reference."""
+"""Test configuration: force an 8-device virtual CPU platform and x64.
+
+NOTE: pytest's plugin discovery (flax/chex entry points) imports jax before
+this conftest executes, so setting JAX_PLATFORMS in os.environ here is too
+late — but the backend initializes lazily, so jax.config.update still wins
+as long as no test touched a device yet.  XLA_FLAGS is read by the CPU
+client at backend creation, which is also still ahead of us.
+"""
 
 import os
 
@@ -10,6 +15,7 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 REFERENCE_DIR = "/root/reference"

@@ -1,0 +1,64 @@
+"""Unit tests for BinMapper parity (reference src/io/bin.cpp:40-156)."""
+
+import numpy as np
+
+from lightgbm_tpu.io.binning import find_bin
+
+
+def test_distinct_values_fast_path():
+    # <= max_bin distinct values: midpoint boundaries, last = +inf
+    vals = np.array([1.0, 2.0, 2.0, 3.0])
+    m = find_bin(vals, total_sample_cnt=4, max_bin=255)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound[:2], [1.5, 2.5])
+    assert np.isinf(m.bin_upper_bound[2])
+    assert not m.is_trivial
+
+
+def test_zero_insertion_between_signs():
+    # negative and positive values, no zeros sampled: reference still
+    # inserts a distinct 0 (bin.cpp:65-68)
+    vals = np.array([-1.0, 1.0])
+    m = find_bin(vals, total_sample_cnt=2, max_bin=255)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound[:2], [-0.5, 0.5])
+
+
+def test_zero_front_insertion_only_with_zero_cnt():
+    vals = np.array([1.0, 2.0])
+    m = find_bin(vals, total_sample_cnt=2, max_bin=255)
+    assert m.num_bin == 2          # no zero inserted
+    m2 = find_bin(vals, total_sample_cnt=5, max_bin=255)  # 3 implied zeros
+    assert m2.num_bin == 3
+    np.testing.assert_allclose(m2.bin_upper_bound[:2], [0.5, 1.5])
+
+
+def test_trivial_feature():
+    m = find_bin(np.array([]), total_sample_cnt=10, max_bin=255)
+    assert m.is_trivial and m.num_bin == 1
+    m = find_bin(np.full(10, 3.25), total_sample_cnt=10, max_bin=255)
+    assert m.is_trivial
+
+
+def test_greedy_binning_bounded():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = find_bin(vals, total_sample_cnt=10000, max_bin=255)
+    assert 2 <= m.num_bin <= 255
+    assert np.isinf(m.bin_upper_bound[-1])
+    # boundaries strictly increasing
+    b = m.bin_upper_bound
+    assert (np.diff(b[:-1]) > 0).all()
+
+
+def test_value_to_bin_roundtrip():
+    vals = np.array([1.0, 2.0, 3.0])
+    m = find_bin(vals, total_sample_cnt=3, max_bin=255)
+    assert list(m.value_to_bin(np.array([0.5, 1.0, 1.6, 2.9, 100.0]))) == \
+        [0, 0, 1, 2, 2]
+
+
+def test_sparse_rate():
+    vals = np.array([5.0])
+    m = find_bin(vals, total_sample_cnt=10, max_bin=255)  # 9 zeros
+    assert abs(m.sparse_rate - 0.9) < 1e-12

@@ -1,0 +1,128 @@
+"""End-to-end numerical parity against the reference binary.
+
+Golden logs/models in tests/golden/ were captured by running the built
+reference (/root/reference) on its own example configs.  With
+hist_dtype=float64 on CPU and the bit-exact RNG replicas, our metric
+trajectories must match every printed digit and the model text must be
+byte-identical.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import config as config_mod
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import load_dataset
+from lightgbm_tpu.metrics import create_metrics
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+
+from conftest import GOLDEN_DIR, REFERENCE_DIR
+
+EXAMPLES = os.path.join(REFERENCE_DIR, "examples")
+
+
+def parse_golden_log(path):
+    """-> {(iter, metric_name): value}"""
+    out = {}
+    pat = re.compile(r"Iteration: (\d+), (.+) : ([-\d.einf]+)$")
+    with open(path) as f:
+        for line in f:
+            m = pat.search(line.strip())
+            if m:
+                out[(int(m.group(1)), m.group(2).strip())] = float(m.group(3))
+    return out
+
+
+def run_example(name, train_file, test_file, iters, extra=()):
+    conf = os.path.join(EXAMPLES, name, "train.conf")
+    params = config_mod.load_parameters(
+        ["config=" + conf,
+         "data=" + os.path.join(EXAMPLES, name, train_file),
+         "valid_data=" + os.path.join(EXAMPLES, name, test_file),
+         "num_trees=%d" % iters, "hist_dtype=float64",
+         "is_save_binary_file=false", *extra])
+    cfg = Config.from_params(params)
+    train = load_dataset(cfg.data, cfg)
+    valid = load_dataset(cfg.valid_data[0], cfg, reference=train)
+    objective = create_objective(cfg)
+    objective.init(train.metadata, train.num_data)
+    tms = []
+    for m in create_metrics(cfg):
+        m.init("training", train.metadata, train.num_data)
+        tms.append(m)
+    vms = []
+    for m in create_metrics(cfg):
+        m.init(test_file, valid.metadata, valid.num_data)
+        vms.append(m)
+    booster = create_boosting(cfg, train, objective,
+                              tms if cfg.is_training_metric else [])
+    booster.add_valid_data(valid, vms)
+    results = {}
+    for it in range(iters):
+        booster.train_one_iter(None, None, False)
+        train_score = np.asarray(booster._training_score())
+        for m in tms:
+            for nm, v in zip(m.names, m.eval(train_score)):
+                results[(it + 1, nm)] = v
+        vs = booster.valid_scores[0]
+        vscore = vs[0] if cfg.num_class == 1 else vs
+        for m in vms:
+            for nm, v in zip(m.names, m.eval(vscore)):
+                results[(it + 1, nm)] = v
+    return booster, results
+
+
+def check_against_golden(results, golden, iters, atol=5e-7):
+    checked = 0
+    for (it, name), val in results.items():
+        if it > iters:
+            continue
+        assert (it, name) in golden, "metric %r not in golden log" % name
+        gv = golden[(it, name)]
+        # golden logs print 6 decimals
+        assert abs(val - gv) < atol + 1e-6, \
+            "iter %d %s: ours %.8f golden %.6f" % (it, name, val, gv)
+        checked += 1
+    assert checked >= iters  # at least one metric per iteration
+
+
+@pytest.mark.slow
+def test_binary_parity():
+    iters = 2
+    booster, results = run_example("binary_classification", "binary.train",
+                                   "binary.test", iters)
+    golden = parse_golden_log(os.path.join(GOLDEN_DIR, "binary_train.log"))
+    check_against_golden(results, golden, iters)
+    # model parity for the trained trees: integer/structure fields must be
+    # byte-identical; float fields may differ in the last printed digit
+    # (f64 summation-order vs the reference's sequential accumulation)
+    golden_model = open(os.path.join(GOLDEN_DIR,
+                                     "golden_binary_model.txt")).read()
+    golden_trees = golden_model.split("Tree=")
+    for i in range(iters):
+        ours = {ln.split("=")[0]: ln.split("=", 1)[1]
+                for ln in booster.models[i].to_string().splitlines() if ln}
+        want = {ln.split("=")[0]: ln.split("=", 1)[1]
+                for ln in golden_trees[i + 1].splitlines()[1:] if "=" in ln}
+        for key in ("num_leaves", "split_feature", "left_child", "right_child",
+                    "leaf_parent", "threshold"):
+            assert ours[key] == want[key], "tree %d %s differs" % (i, key)
+        for key in ("split_gain", "leaf_value", "internal_value"):
+            a = np.array(ours[key].split(), dtype=np.float64)
+            b = np.array(want[key].split(), dtype=np.float64)
+            np.testing.assert_allclose(a, b, rtol=2e-6,
+                                       err_msg="tree %d %s" % (i, key))
+
+
+@pytest.mark.slow
+def test_regression_parity():
+    iters = 2
+    _, results = run_example("regression", "regression.train",
+                             "regression.test", iters)
+    golden = parse_golden_log(os.path.join(GOLDEN_DIR,
+                                           "regression_train.log"))
+    check_against_golden(results, golden, iters)
