@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Headline benchmark: GBDT training wall-clock vs the reference CPU binary.
+
+Workload: synthetic binary classification, N=1,000,000 rows x F=28 features
+(the HIGGS shape at 1/11 scale), 100 trees, num_leaves=63, max_bin=255 —
+the reference's own recommended settings (examples/binary_classification/
+train.conf:29-57).
+
+Both sides train on identical data on this host:
+  - ours: lightgbm_tpu on the default JAX device (TPU when available),
+    training-loop wall-clock measured after a 1-iteration warm-up booster
+    has triggered XLA compilation (compile time reported separately in
+    `compile_s`; it is a one-time per-shape cost).
+  - baseline: the reference C++ binary (built from /root/reference into
+    .ref_build/, never written back), training time taken from its own
+    "N seconds elapsed, finished iteration 100" log line, which likewise
+    excludes data loading.  The result is cached in .bench_cache/ keyed by
+    workload + cpu count.
+
+Prints ONE JSON line:
+  {"metric": "train_wall_100trees_1Mx28", "value": <our seconds>,
+   "unit": "s", "vs_baseline": <ref_seconds / our_seconds>, ...extras}
+vs_baseline > 1 means we beat the reference.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(REPO, ".bench_cache")
+REF_SRC = "/root/reference"
+REF_BUILD = os.path.join(REPO, ".ref_build")
+
+N_ROWS = 1_000_000
+N_FEAT = 28
+NUM_TREES = 100
+NUM_LEAVES = 63
+MAX_BIN = 255
+MIN_DATA_IN_LEAF = 100
+LEARNING_RATE = 0.1
+SEED = 42
+
+
+def make_data():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(N_ROWS, N_FEAT).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+         + 0.3 * rng.randn(N_ROWS) > 0).astype(np.float32)
+    return x, y
+
+
+def holdout_data():
+    rng = np.random.RandomState(SEED + 1)
+    x = rng.randn(100_000, N_FEAT).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+         + 0.3 * rng.randn(100_000) > 0).astype(np.float32)
+    return x, y
+
+
+def _params():
+    return {
+        "objective": "binary", "num_leaves": str(NUM_LEAVES),
+        "max_bin": str(MAX_BIN), "min_data_in_leaf": str(MIN_DATA_IN_LEAF),
+        "learning_rate": str(LEARNING_RATE), "metric": "",
+    }
+
+
+def build_dataset(cfg, x, y):
+    from lightgbm_tpu.io.binning import find_bins
+    from lightgbm_tpu.io.dataset import Dataset, Metadata
+
+    rng = np.random.RandomState(SEED)
+    sample = rng.choice(N_ROWS, 50_000, replace=False)
+    mappers = find_bins(x[sample], len(sample), cfg.max_bin)
+    bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
+                     for j, m in enumerate(mappers)])
+    return Dataset(bins=bins, bin_mappers=mappers,
+                   used_feature_map=np.arange(N_FEAT, dtype=np.int32),
+                   real_feature_index=np.arange(N_FEAT, dtype=np.int32),
+                   num_total_features=N_FEAT,
+                   feature_names=["Column_%d" % i for i in range(N_FEAT)],
+                   metadata=Metadata(label=y))
+
+
+def run_ours():
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    x, y = make_data()
+    cfg = Config.from_params(_params())
+
+    t0 = time.time()
+    ds = build_dataset(cfg, x, y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = create_boosting(cfg, ds, obj)
+    setup_s = time.time() - t0
+
+    # warm-up: one iteration on a throwaway booster triggers all XLA
+    # compilations (cached by shape for the real run)
+    warm = create_boosting(cfg, ds, obj)
+    t0 = time.time()
+    warm.train_one_iter(None, None, False)
+    jax.block_until_ready(warm.scores)
+    compile_s = time.time() - t0
+    del warm
+
+    t0 = time.time()
+    for _ in range(NUM_TREES):
+        booster.train_one_iter(None, None, False)
+    jax.block_until_ready(booster.scores)
+    train_s = time.time() - t0
+
+    xh, yh = holdout_data()
+    pred = booster.predict(xh)[0]
+    order = np.argsort(pred)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(len(pred))
+    npos = yh.sum()
+    auc = ((ranks[yh == 1].sum() - npos * (npos - 1) / 2)
+           / (npos * (len(yh) - npos)))
+    return {"train_s": train_s, "compile_s": compile_s, "setup_s": setup_s,
+            "auc": float(auc), "backend": jax.default_backend()}
+
+
+def ensure_ref_binary():
+    exe = os.path.join(REF_BUILD, "ref_src", "lightgbm")
+    if os.path.exists(exe):
+        return exe
+    os.makedirs(REF_BUILD, exist_ok=True)
+    src_copy = os.path.join(REF_BUILD, "ref_src")
+    if not os.path.exists(src_copy):
+        subprocess.run(["cp", "-r", REF_SRC, src_copy], check=True)
+        subprocess.run(["rm", "-rf", os.path.join(src_copy, ".git")],
+                       check=True)
+    bdir = os.path.join(REF_BUILD, "build")
+    os.makedirs(bdir, exist_ok=True)
+    subprocess.run(["cmake", src_copy, "-DCMAKE_BUILD_TYPE=Release"],
+                   cwd=bdir, check=True, capture_output=True)
+    subprocess.run(["make", "-j8"], cwd=bdir, check=True,
+                   capture_output=True)
+    return exe
+
+
+def run_reference():
+    """Reference binary training seconds (cached per workload+host)."""
+    ncpu = os.cpu_count()
+    key = "ref_%dx%d_t%d_l%d_b%d_cpu%d.json" % (
+        N_ROWS, N_FEAT, NUM_TREES, NUM_LEAVES, MAX_BIN, ncpu)
+    cache_f = os.path.join(CACHE, key)
+    if os.path.exists(cache_f):
+        with open(cache_f) as f:
+            return json.load(f)
+
+    exe = ensure_ref_binary()
+    os.makedirs(CACHE, exist_ok=True)
+    train_file = os.path.join(CACHE, "bench.train")
+    if not os.path.exists(train_file):
+        x, y = make_data()
+        np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
+                   fmt="%.6g", delimiter="\t")
+    out = subprocess.run(
+        [exe, "task=train", "data=" + train_file, "objective=binary",
+         "num_trees=%d" % NUM_TREES, "num_leaves=%d" % NUM_LEAVES,
+         "max_bin=%d" % MAX_BIN, "min_data_in_leaf=%d" % MIN_DATA_IN_LEAF,
+         "learning_rate=%g" % LEARNING_RATE, "metric=",
+         "is_save_binary_file=false", "output_model=/dev/null"],
+        capture_output=True, text=True, cwd=CACHE, check=True)
+    last = None
+    for line in out.stdout.splitlines():
+        m = re.search(r"([\d.]+) seconds elapsed, finished iteration (\d+)",
+                      line)
+        if m:
+            last = (float(m.group(1)), int(m.group(2)))
+    if last is None or last[1] != NUM_TREES:
+        raise RuntimeError("could not parse reference timing:\n" + out.stdout)
+    res = {"ref_train_s": last[0], "ncpu": ncpu}
+    with open(cache_f, "w") as f:
+        json.dump(res, f)
+    return res
+
+
+def main():
+    ours = run_ours()
+    try:
+        ref = run_reference()
+        vs = ref["ref_train_s"] / ours["train_s"]
+    except Exception as e:  # reference unavailable: report ours alone
+        ref = {"ref_train_s": None, "error": str(e)[:200]}
+        vs = 0.0
+    print(json.dumps({
+        "metric": "train_wall_100trees_1Mx28",
+        "value": round(ours["train_s"], 3),
+        "unit": "s",
+        "vs_baseline": round(vs, 4),
+        "ref_train_s": ref.get("ref_train_s"),
+        "compile_s": round(ours["compile_s"], 3),
+        "auc_holdout": round(ours["auc"], 5),
+        "backend": ours["backend"],
+        "ncpu": os.cpu_count(),
+        "trees_per_s": round(NUM_TREES / ours["train_s"], 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

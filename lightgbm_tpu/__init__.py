@@ -17,6 +17,7 @@ Public surface:
 __version__ = "0.1.0"
 
 from .config import Config                      # noqa: F401
-from .io.dataset import Dataset, load_dataset   # noqa: F401
+from .io.dataset import load_dataset            # noqa: F401
 from .models.gbdt import GBDT, DART             # noqa: F401
 from .models.tree import Tree                   # noqa: F401
+from .api import Dataset, Booster, train        # noqa: F401

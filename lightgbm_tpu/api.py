@@ -1,0 +1,471 @@
+"""Python API — the embedding surface replacing the reference C API.
+
+Operation-for-operation equivalent of src/c_api.cpp / include/LightGBM/
+c_api.h, exposed the way a Python framework should be (objects, numpy /
+scipy matrices) instead of C handles:
+
+  reference c_api.h                      here
+  -------------------------------------  --------------------------------
+  LGBM_CreateDatasetFromFile (:58)       Dataset(path, ...)
+  LGBM_CreateDatasetFromBinaryFile(:72)  Dataset.load_binary(path)
+  LGBM_CreateDatasetFromMat (:117)       Dataset(ndarray, ...)
+  LGBM_CreateDatasetFromCSR (:86)        Dataset(csr_matrix, ...)
+  LGBM_CreateDatasetFromCSC (:103)       Dataset(csc_matrix, ...)
+  LGBM_DatasetSaveBinary (:140)          Dataset.save_binary(path)
+  LGBM_DatasetSetField (:152)            Dataset.set_field / set_label ...
+  LGBM_DatasetGetField (:166)            Dataset.get_field
+  LGBM_DatasetGetNumData/Feature (:178)  Dataset.num_data / num_feature
+  LGBM_BoosterCreate (:198)              Booster(params, train_set)
+  LGBM_BoosterCreateFromModelfile(:209)  Booster(model_file=...)
+  LGBM_BoosterAddValidData (:228)        Booster.add_valid
+  LGBM_BoosterUpdateOneIter (:247)       Booster.update()
+  LGBM_BoosterUpdateOneIterCustom(:259)  Booster.update(fobj=...)
+  LGBM_BoosterEval (:285)                Booster.eval / eval_train/valid
+  LGBM_BoosterPredict* (:313-368)        Booster.predict(raw_score=...,
+                                           pred_leaf=...)
+  LGBM_BoosterSaveModel (:383)           Booster.save_model
+  (sample-then-push construction mirrors c_api.cpp:185-231; validation
+   bin alignment via `reference=` mirrors c_api.cpp:158-183)
+
+plus a `train()` convenience driver (the Application train loop,
+src/application/application.cpp:218-236, incl. early stopping).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, apply_aliases
+from .io.binning import BinMapper, find_bin
+from .io import dataset as io_dataset
+from .metrics import create_metrics
+from .models.gbdt import GBDT, create_boosting
+from .objectives import create_objective
+from .utils import log
+
+ArrayLike = Union[np.ndarray, "scipy.sparse.spmatrix", str]  # noqa: F821
+
+
+def _to_config(params: Optional[Dict]) -> Config:
+    p = {str(k): str(v) for k, v in (params or {}).items()}
+    return Config.from_params(apply_aliases(p))
+
+
+def _as_dense(data) -> np.ndarray:
+    """Accept ndarray / scipy CSR / CSC (the reference's 4 matrix adapters,
+    c_api.cpp:589-770); densify sparse — the TPU representation is dense
+    binned anyway (SURVEY.md §7.1)."""
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(data):
+            return np.asarray(data.todense(), dtype=np.float64)
+    except ImportError:
+        pass
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim != 2:
+        raise ValueError("data must be 2-dimensional, got shape %r"
+                         % (arr.shape,))
+    return arr
+
+
+class Dataset:
+    """Binned training data (reference DatasetHandle).
+
+    data: 2-D numpy array [N, F], scipy sparse matrix, or a text-file path
+    (CSV/TSV/LibSVM, auto-detected like src/io/parser.cpp:72-144).
+    reference: align bins to another Dataset's mappers (validation data),
+    like LGBM_CreateDatasetFromFile's reference argument.
+    group: per-query row counts (the .query file convention,
+    src/io/metadata.cpp:252-327) or per-row query ids.
+    """
+
+    def __init__(self, data: ArrayLike, label=None,
+                 params: Optional[Dict] = None,
+                 reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_names: Optional[Sequence[str]] = None,
+                 free_raw_data: bool = True):
+        self.params = dict(params or {})
+        self.config = _to_config(params)
+        self._reference = reference
+        self._inner: Optional[io_dataset.Dataset] = None
+        self._raw = data
+        self._label = label
+        self._weight = weight
+        self._group = group
+        self._init_score = init_score
+        self._feature_names = list(feature_names) if feature_names else None
+        self.free_raw_data = free_raw_data
+        if isinstance(data, str):
+            self._construct_from_file(data)
+        else:
+            self._construct_from_matrix(_as_dense(data))
+
+    # -- construction --------------------------------------------------
+    def _construct_from_file(self, path: str) -> None:
+        ref = self._reference.inner if self._reference is not None else None
+        self._inner = io_dataset.load_dataset(path, self.config,
+                                              reference=ref)
+        self._apply_field_overrides()
+
+    def _construct_from_matrix(self, mat: np.ndarray) -> None:
+        n, ncols = mat.shape
+        if self._label is None:
+            log.warning("Dataset created without a label")
+            self._label = np.zeros(n, dtype=np.float32)
+        label = np.asarray(self._label, dtype=np.float32).reshape(n)
+
+        if self._reference is not None:
+            refin = self._reference.inner
+            ds = io_dataset.Dataset(
+                bins=np.zeros((refin.num_features, n),
+                              dtype=refin.bins.dtype),
+                bin_mappers=refin.bin_mappers,
+                used_feature_map=refin.used_feature_map,
+                real_feature_index=refin.real_feature_index,
+                num_total_features=refin.num_total_features,
+                feature_names=refin.feature_names,
+                metadata=io_dataset.Metadata(label=label))
+            ds.bins = ds.bin_feature_values(mat)
+            self._inner = ds
+            self._apply_field_overrides()
+            return
+
+        cfg = self.config
+        # sample-then-push construction (c_api.cpp:185-231 ->
+        # DatasetLoader::CostructFromSampleData, dataset_loader.cpp:408-453)
+        sample_cnt = min(cfg.bin_construct_sample_cnt, n)
+        if sample_cnt < n:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample = mat[np.sort(rng.choice(n, sample_cnt, replace=False))]
+        else:
+            sample = mat
+
+        mappers_all: List[Optional[BinMapper]] = [
+            find_bin(sample[:, j], sample.shape[0], cfg.max_bin)
+            for j in range(ncols)]
+
+        used_feature_map = np.full(ncols, -1, dtype=np.int32)
+        bin_mappers: List[BinMapper] = []
+        real_index: List[int] = []
+        names = (self._feature_names
+                 or ["Column_%d" % i for i in range(ncols)])
+        for j, m in enumerate(mappers_all):
+            if m.is_trivial:
+                log.warning("Ignoring feature %s, only has one value"
+                            % names[j])
+                continue
+            used_feature_map[j] = len(bin_mappers)
+            bin_mappers.append(m)
+            real_index.append(j)
+        if not bin_mappers:
+            log.fatal("No usable features in data")
+
+        max_bin_used = max(m.num_bin for m in bin_mappers)
+        dtype = np.uint8 if max_bin_used <= 256 else np.uint16
+        bins = np.zeros((len(bin_mappers), n), dtype=dtype)
+        for inner, real in enumerate(real_index):
+            bins[inner] = bin_mappers[inner].value_to_bin(
+                mat[:, real]).astype(dtype)
+
+        self._inner = io_dataset.Dataset(
+            bins=bins, bin_mappers=bin_mappers,
+            used_feature_map=used_feature_map,
+            real_feature_index=np.asarray(real_index, dtype=np.int32),
+            num_total_features=ncols, feature_names=names,
+            metadata=io_dataset.Metadata(label=label))
+        self._apply_field_overrides()
+
+    def _apply_field_overrides(self) -> None:
+        if self._weight is not None:
+            self.set_weight(self._weight)
+        if self._group is not None:
+            self.set_group(self._group)
+        if self._init_score is not None:
+            self.set_init_score(self._init_score)
+        if self.free_raw_data:
+            self._raw = None
+
+    # -- fields (LGBM_DatasetSet/GetField, c_api.cpp:357-391) ----------
+    @property
+    def inner(self) -> io_dataset.Dataset:
+        return self._inner
+
+    def set_field(self, name: str, data) -> None:
+        md = self._inner.metadata
+        if name == "label":
+            md.label = np.asarray(data, dtype=np.float32).reshape(-1)
+        elif name == "weight":
+            md.weights = (None if data is None else
+                          np.asarray(data, dtype=np.float32).reshape(-1))
+            md.finish_queries()
+        elif name == "init_score":
+            md.init_score = (None if data is None else
+                             np.asarray(data, dtype=np.float64).reshape(-1))
+        elif name == "group" or name == "query":
+            if data is None:
+                md.query_boundaries = None
+                return
+            g = np.asarray(data, dtype=np.int64).reshape(-1)
+            if g.sum() == self.num_data():
+                # per-query counts (the .query-file convention; checked
+                # first so group=[1]*N means N singleton queries)
+                md.query_boundaries = np.concatenate(
+                    [[0], np.cumsum(g)]).astype(np.int32)
+            elif len(g) == self.num_data():
+                # per-row query ids -> boundaries (metadata.cpp:66-92)
+                change = np.nonzero(np.diff(g))[0] + 1
+                md.query_boundaries = np.concatenate(
+                    [[0], change, [len(g)]]).astype(np.int32)
+            else:
+                log.fatal("group must be per-query counts summing to "
+                          "num_data or per-row query ids of length "
+                          "num_data")
+            md.finish_queries()
+        else:
+            log.fatal("Unknown dataset field %s" % name)
+
+    def get_field(self, name: str):
+        md = self._inner.metadata
+        if name == "label":
+            return md.label
+        if name == "weight":
+            return md.weights
+        if name == "init_score":
+            return md.init_score
+        if name == "group" or name == "query":
+            return md.query_boundaries
+        log.fatal("Unknown dataset field %s" % name)
+
+    def set_label(self, label) -> None:
+        self.set_field("label", label)
+
+    def set_weight(self, weight) -> None:
+        self.set_field("weight", weight)
+
+    def set_group(self, group) -> None:
+        self.set_field("group", group)
+
+    def set_init_score(self, init_score) -> None:
+        self.set_field("init_score", init_score)
+
+    def get_label(self) -> np.ndarray:
+        return self.get_field("label")
+
+    # -- info ----------------------------------------------------------
+    def num_data(self) -> int:
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        return self._inner.num_features
+
+    @property
+    def feature_name(self) -> List[str]:
+        return list(self._inner.feature_names)
+
+    # -- binary round-trip (LGBM_DatasetSaveBinary, c_api.cpp:343-355) -
+    def save_binary(self, path: str) -> None:
+        io_dataset._save_binary(self._inner, path)
+
+    @classmethod
+    def load_binary(cls, path: str,
+                    params: Optional[Dict] = None) -> "Dataset":
+        out = cls.__new__(cls)
+        out.params = dict(params or {})
+        out.config = _to_config(params)
+        out._reference = None
+        out._raw = None
+        out._label = out._weight = out._group = out._init_score = None
+        out._feature_names = None
+        out.free_raw_data = True
+        out._inner = io_dataset._load_binary(path)
+        return out
+
+
+class Booster:
+    """Boosting session over a Dataset (reference Booster, c_api.cpp:24-148).
+
+    Exactly one of train_set / model_file / model_str must be given.
+    """
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        if sum(x is not None
+               for x in (train_set, model_file, model_str)) != 1:
+            raise ValueError("need exactly one of train_set / model_file"
+                             " / model_str")
+        if train_set is not None:
+            self.config = _to_config(self.params)
+            self.train_set = train_set
+            objective = create_objective(self.config)
+            objective.init(train_set.inner.metadata, train_set.num_data())
+            self._train_metrics = []
+            for m in create_metrics(self.config):
+                m.init("training", train_set.inner.metadata,
+                       train_set.num_data())
+                self._train_metrics.append(m)
+            self._gbdt = create_boosting(self.config, train_set.inner,
+                                         objective, self._train_metrics)
+            self._valid_names: List[str] = []
+        else:
+            text = model_str
+            if model_file is not None:
+                with open(model_file) as f:
+                    text = f.read()
+            first_line = text.lstrip().split("\n", 1)[0].strip()
+            p = dict(self.params)
+            p.setdefault("boosting_type",
+                         "dart" if first_line == "dart" else "gbdt")
+            self.config = _to_config(p)
+            self.train_set = None
+            self._gbdt = GBDT(self.config, None, None)
+            self._gbdt.load_model_from_string(text)
+            self._train_metrics = []
+            self._valid_names = []
+
+    # -- training ------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> None:
+        """LGBM_BoosterAddValidData (c_api.cpp:430-437)."""
+        metrics = []
+        for m in create_metrics(self.config):
+            m.init(name, data.inner.metadata, data.num_data())
+            metrics.append(m)
+        self._gbdt.add_valid_data(data.inner, metrics)
+        self._valid_names.append(name)
+
+    def update(self, fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (no further splits / early stop).  fobj(score, train_inner) ->
+        (grad, hess) is the custom-objective path
+        (LGBM_BoosterUpdateOneIterCustom, c_api.cpp:455-467); score has
+        shape [N] (or [K, N] multiclass), gradients laid out the same."""
+        if self.train_set is None:
+            raise RuntimeError("Booster was loaded from a model file;"
+                               " no training data")
+        if fobj is None:
+            return self._gbdt.train_one_iter(None, None, False)
+        score = np.asarray(self._gbdt._training_score())
+        grad, hess = fobj(score, self.train_set)
+        grad = np.asarray(grad, dtype=np.float32)
+        hess = np.asarray(hess, dtype=np.float32)
+        return self._gbdt.train_one_iter(grad, hess, False)
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.iter
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_class
+
+    # -- eval (LGBM_BoosterEval / GetEvalNames, c_api.cpp:469-527) ------
+    def eval_train(self) -> List[tuple]:
+        return self._eval_at(0, "training")
+
+    def eval_valid(self, idx: int = 0) -> List[tuple]:
+        name = (self._valid_names[idx]
+                if idx < len(self._valid_names) else "valid_%d" % idx)
+        return self._eval_at(idx + 1, name)
+
+    def _eval_at(self, data_idx: int, name: str) -> List[tuple]:
+        vals = self._gbdt.get_eval_at(data_idx)
+        metrics = (self._train_metrics if data_idx == 0
+                   else self._gbdt.valid_metrics[data_idx - 1])
+        out = []
+        i = 0
+        for m in metrics:
+            for mname in m.names:
+                out.append((name, mname, float(vals[i]),
+                            m.factor_to_bigger_better > 0))
+                i += 1
+        return out
+
+    # -- prediction (LGBM_BoosterPredictForMat etc.) --------------------
+    def predict(self, data, raw_score: bool = False,
+                pred_leaf: bool = False,
+                num_iteration: int = -1) -> np.ndarray:
+        mat = _as_dense(data)
+        saved = self._gbdt.num_used_model
+        if num_iteration > 0:    # <= 0 means all iterations (c_api.h:313)
+            self._gbdt.set_num_used_model(
+                num_iteration * self._gbdt.num_class)
+        try:
+            if pred_leaf:
+                return self._gbdt.predict_leaf_index(mat)
+            if raw_score:
+                out = self._gbdt.predict_raw(mat)
+            else:
+                out = self._gbdt.predict(mat)
+        finally:
+            self._gbdt.num_used_model = saved
+        return out[0] if out.shape[0] == 1 else out.T
+
+    # -- model io (LGBM_BoosterSaveModel / LoadModelFromString) ---------
+    def save_model(self, path: str, num_iteration: int = -1) -> None:
+        # the GBDT save path is incremental (per-iteration append,
+        # gbdt.cpp:351-400); reset its cursor for a standalone full save
+        if self._gbdt._model_file is not None:
+            self._gbdt._model_file.close()
+            self._gbdt._model_file = None
+        self._gbdt.saved_upto = -1
+        self._gbdt.save_model_to_file(num_iteration, True, path)
+
+    def model_to_string(self, num_iteration: int = -1) -> str:
+        import tempfile
+        import os as _os
+        fd, tmp = tempfile.mkstemp(suffix=".txt")
+        _os.close(fd)
+        try:
+            self.save_model(tmp, num_iteration)
+            with open(tmp) as f:
+                return f.read()
+        finally:
+            _os.unlink(tmp)
+
+    def feature_importance(self) -> Dict[str, int]:
+        """Split-count importances (GBDT::FeatureImportance,
+        gbdt.cpp:458-485)."""
+        td = self._gbdt.train_data
+        names = (td.feature_names if td is not None else None)
+        counts: Dict[str, int] = {}
+        for tree in self._gbdt.models:
+            for fi in tree.split_feature_real[:tree.num_leaves - 1]:
+                name = (names[fi] if names and fi < len(names)
+                        else "Column_%d" % fi)
+                counts[name] = counts.get(name, 0) + 1
+        return dict(sorted(counts.items(), key=lambda kv: -kv[1]))
+
+
+def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Sequence[Dataset] = (),
+          valid_names: Optional[Sequence[str]] = None,
+          fobj: Optional[Callable] = None,
+          early_stopping_rounds: Optional[int] = None,
+          verbose_eval: Union[bool, int] = True) -> Booster:
+    """Train-loop driver (Application::Train, application.cpp:218-236)."""
+    p = dict(params)
+    if early_stopping_rounds is not None:
+        p["early_stopping_round"] = early_stopping_rounds
+    booster = Booster(p, train_set=train_set)
+    names = list(valid_names or
+                 ["valid_%d" % i for i in range(len(valid_sets))])
+    for ds, name in zip(valid_sets, names):
+        booster.add_valid(ds, name)
+    freq = (1 if verbose_eval is True
+            else 0 if verbose_eval is False else int(verbose_eval))
+    # metric printing + early stopping ride GBDT::OutputMetric
+    # (gbdt.cpp:231-267); metric_freq controls the print cadence
+    gbdt = booster._gbdt
+    gbdt.config.metric_freq = freq if freq > 0 else (1 << 30)
+    early = gbdt.early_stopping_round > 0
+    for _ in range(num_boost_round):
+        stop = booster.update(fobj=fobj)
+        if not stop and (freq > 0 or early):
+            stop = gbdt.eval_and_check_early_stopping()
+        if stop:
+            break
+    return booster

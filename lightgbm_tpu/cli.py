@@ -30,6 +30,13 @@ class Application:
     def __init__(self, argv: List[str]):
         params = config_mod.load_parameters(argv)
         self.config = Config.from_params(params)
+        if self.config.device_type == "cpu":
+            # must run before any JAX backend initializes; overrides the
+            # platform even when the environment pins JAX_PLATFORMS
+            # (device_type=tpu keeps the environment's accelerator platform,
+            # whatever its registered name)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
 
     def run(self) -> None:
         if self.config.task == "train":

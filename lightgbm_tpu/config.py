@@ -180,6 +180,7 @@ class Config:
     hist_dtype: str = "float32"           # histogram accumulator dtype
     hist_impl: str = "auto"               # auto | xla | pallas
     donate_buffers: bool = True
+    device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
     # ---------------------------------------------------------------------
     @staticmethod
@@ -312,6 +313,10 @@ class Config:
         set_str("hist_dtype")
         set_str("hist_impl")
         set_bool("donate_buffers")
+        set_str("device_type")
+        if c.device_type not in ("", "cpu", "tpu"):
+            log.fatal("Unknown device_type %s (expect cpu|tpu)"
+                      % c.device_type)
         if c.hist_impl not in ("auto", "xla", "pallas"):
             log.fatal("Unknown hist_impl %s (expect auto|xla|pallas)"
                       % c.hist_impl)
