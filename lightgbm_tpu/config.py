@@ -60,6 +60,7 @@ ALIAS_TABLE: Dict[str, str] = {
     "sub_row": "bagging_fraction",
     "shrinkage_rate": "learning_rate",
     "tree": "tree_learner",
+    "topk": "top_k",
     "num_machine": "num_machines",
     "local_port": "local_listen_port",
     "two_round_loading": "use_two_round_loading",
@@ -106,7 +107,8 @@ class Config:
     boosting_type: str = "gbdt"           # gbdt | dart
     objective: str = "regression"         # regression | binary | multiclass | lambdarank
     metric: List[str] = dataclasses.field(default_factory=list)
-    tree_learner: str = "serial"          # serial | feature | data
+    tree_learner: str = "serial"          # serial | feature | data | voting
+    top_k: int = 20                       # voting-parallel votes per shard
     is_parallel: bool = False
     is_parallel_find_bin: bool = False
 
@@ -234,12 +236,14 @@ class Config:
             c.metric = seen
         if "tree_learner" in params:
             tl = getp("tree_learner").lower()
-            if tl in ("serial", "feature", "data"):
+            if tl in ("serial", "feature", "data", "voting"):
                 c.tree_learner = tl
             elif tl in ("feature_parallel",):
                 c.tree_learner = "feature"
             elif tl in ("data_parallel",):
                 c.tree_learner = "data"
+            elif tl in ("voting_parallel",):
+                c.tree_learner = "voting"
             else:
                 log.fatal("Unknown tree learner type %s" % tl)
 
@@ -310,6 +314,7 @@ class Config:
 
         # tpu
         set_int("num_shards")
+        set_int("top_k")
         set_str("hist_dtype")
         set_str("hist_impl")
         set_bool("donate_buffers")
@@ -362,6 +367,11 @@ class Config:
                     "Histogram LRU queue was enabled (histogram_pool_size=%f). "
                     "Will disable this to reduce communication costs" % self.histogram_pool_size)
                 self.histogram_pool_size = NO_LIMIT
+        elif self.tree_learner == "voting":
+            self.is_parallel = True
+            self.is_parallel_find_bin = True
+            if self.top_k <= 0:
+                log.fatal("top_k must be positive for voting-parallel")
 
 
 def apply_aliases(params: Dict[str, str]) -> Dict[str, str]:

@@ -113,14 +113,27 @@ class GBDT:
         # Rows are padded so each shard's slice is a multiple of the Pallas
         # row block; padded rows are permanently out-of-bag.
         self.grower = None
-        if config.tree_learner == "data":
+        self.rows_sharded = False
+        if config.tree_learner in ("data", "voting"):
             from ..parallel.mesh import ShardedGrower, make_mesh
             mesh = make_mesh(config.num_shards)
             self.grower = ShardedGrower(
                 mesh, max_leaves=max(config.num_leaves, 2),
                 max_bin=self.max_bin, params=self.params,
-                max_depth=config.max_depth, hist_impl=impl)
+                max_depth=config.max_depth,
+                voting_top_k=(config.top_k
+                              if config.tree_learner == "voting" else 0),
+                hist_impl=impl)
             row_unit *= self.grower.num_shards
+            self.rows_sharded = True
+        elif config.tree_learner == "feature":
+            from ..parallel.mesh import (FeatureShardedGrower, make_mesh,
+                                         FEATURE_AXIS)
+            mesh = make_mesh(config.num_shards, FEATURE_AXIS)
+            self.grower = FeatureShardedGrower(
+                mesh, max_leaves=max(config.num_leaves, 2),
+                max_bin=self.max_bin, params=self.params,
+                max_depth=config.max_depth, hist_impl=impl)
         self.n_pad = ((n + row_unit - 1) // row_unit) * row_unit
 
         bins = train_data.bins
@@ -131,9 +144,10 @@ class GBDT:
             self.scores = jnp.pad(self.scores,
                                   ((0, 0), (0, self.n_pad - n)))
         if self.grower is not None:
-            self.bins_dev = jax.device_put(bins, self.grower.bins_sharding())
-            self.scores = jax.device_put(self.scores,
-                                         self.grower.row_sharding_2d())
+            self.bins_dev = self.grower.shard_bins(bins)
+            if self.rows_sharded:
+                self.scores = jax.device_put(
+                    self.scores, self.grower.row_sharding_2d())
         else:
             self.bins_dev = jnp.asarray(bins)
         if objective is not None and self.n_pad != n:

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from .histogram import leaf_histogram, make_gvals
 from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
-                    leaf_output)
+                    leaf_output, per_feature_best)
 
 
 class TreeArrays(NamedTuple):
@@ -97,27 +97,111 @@ def _set_best(best: BestSplit, leaf, s: BestSplit) -> BestSplit:
     return BestSplit(*[arr.at[leaf].set(v) for arr, v in zip(best, s)])
 
 
+def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
+                               ) -> BestSplit:
+    """Combine per-shard best splits into the global best, replicated.
+
+    The TPU equivalent of FeatureParallelTreeLearner's
+    Allreduce(SplitInfo::MaxReducer) (reference
+    src/treelearner/feature_parallel_tree_learner.cpp:45-78 and
+    split_info.hpp:56-104): max gain, ties broken by the SMALLER global
+    feature index, so every shard picks the identical winner.
+    """
+    glob = s._replace(feature=s.feature + f_offset)
+    gathered = jax.tree_util.tree_map(
+        lambda a: jax.lax.all_gather(a, feature_axis), glob)
+    mx = jnp.max(gathered.gain)
+    eligible = gathered.gain == mx
+    win = jnp.argmin(jnp.where(eligible, gathered.feature,
+                               jnp.iinfo(jnp.int32).max))
+    return jax.tree_util.tree_map(lambda a: a[win], gathered)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
-                     "row_chunk", "psum_axis", "hist_impl"))
+                     "row_chunk", "psum_axis", "feature_axis",
+                     "voting_top_k", "hist_impl"))
 def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               bag_mask: jax.Array, feature_mask: jax.Array, *,
               max_leaves: int, max_bin: int, params: SplitParams,
               max_depth: int = -1, row_chunk: int = 0,
-              psum_axis: Optional[str] = None, hist_impl: str = "xla"):
+              psum_axis: Optional[str] = None,
+              feature_axis: Optional[str] = None,
+              voting_top_k: int = 0, hist_impl: str = "xla"):
     """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
 
     bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
     feature_mask [F] bool. All per-split control flow is on-device.
     hist_impl: "xla" (portable one-hot matmul) or "pallas" (TPU radix
     kernel, f32, max_bin<=256, N % 8192 == 0).
+    psum_axis: mesh axis sharding rows (tree_learner=data).
+    feature_axis: mesh axis sharding features (tree_learner=feature) —
+    bins_t/feature_mask hold this shard's features; rows are replicated;
+    tree arrays come out replicated with GLOBAL feature indices.
+    voting_top_k (>0, with psum_axis): tree_learner=voting — PV-Tree
+    two-round voting (absent from the reference snapshot, SURVEY.md §2.9;
+    design per the LightGBM paper): histograms stay shard-local, each
+    shard votes its top-k features by local gain, and only the 2k
+    vote-winning features' histograms are all-reduced, cutting per-split
+    traffic from O(F*B) to O(2k*B).
     """
     f, n = bins_t.shape
     dtype = grad.dtype
+    voting = voting_top_k > 0 and psum_axis is not None
+
+    if feature_axis is not None:
+        f_offset = (jax.lax.axis_index(feature_axis) * f).astype(jnp.int32)
 
     def psum(x):
         return jax.lax.psum(x, psum_axis) if psum_axis else x
+
+    def best_of(hist, cnt, sg, sh):
+        """find_best_split + cross-shard reduction.  In voting mode `hist`
+        is shard-LOCAL; cnt/sg/sh are always global leaf stats."""
+        if voting:
+            # local scoring pass over local totals
+            lsg = jnp.sum(hist[0, :, 0])
+            lsh = jnp.sum(hist[0, :, 1])
+            lcnt = jnp.round(jnp.sum(hist[0, :, 2])).astype(jnp.int32)
+            gains_f, _ = per_feature_best(hist, lcnt, lsg, lsh,
+                                          feature_mask, params)
+            k = min(voting_top_k, f)
+            topv, topi = jax.lax.top_k(gains_f, k)
+            votes = jnp.zeros(f, dtype=jnp.float32).at[topi].add(
+                jnp.where(topv > K_MIN_SCORE, 1.0, 0.0))
+            votes = jax.lax.psum(votes, psum_axis)
+            # global top-2k by votes, ties to the smaller feature index
+            # (unique integer-valued keys keep top_k deterministic)
+            k2 = min(2 * voting_top_k, f)
+            key = votes * (f + 1) - jnp.arange(f, dtype=jnp.float32)
+            cand = jax.lax.top_k(key, k2)[1].astype(jnp.int32)
+            cand_hist = jax.lax.psum(hist[cand], psum_axis)
+            s = find_best_split(cand_hist, cnt, sg, sh,
+                                feature_mask[cand], params)
+            return s._replace(feature=cand[s.feature])
+        s = find_best_split(hist, cnt, sg, sh, feature_mask, params)
+        if feature_axis is not None:
+            s = _reduce_best_over_features(s, f_offset, feature_axis)
+        return s
+
+    def feature_bin_row(feature):
+        """bins_t[feature] with a GLOBAL feature index: the owner shard
+        contributes the row, a psum over the feature axis replicates it
+        (all machines have all rows, feature_parallel_tree_learner.cpp's
+        premise, so the split is applied shard-locally everywhere)."""
+        if feature_axis is None:
+            return bins_t[feature].astype(jnp.int32)
+        local = feature - f_offset
+        owner = (local >= 0) & (local < f)
+        row = jnp.where(owner,
+                        bins_t[jnp.clip(local, 0, f - 1)].astype(jnp.int32),
+                        0)
+        return jax.lax.psum(row, feature_axis)
+
+    # voting keeps histograms shard-local (only candidate features are
+    # all-reduced inside best_of); other modes all-reduce the full tensor
+    hist_psum = (lambda x: x) if voting else psum
 
     if hist_impl == "pallas":
         from .hist_pallas import leaf_histogram_masked, make_gh8
@@ -127,14 +211,14 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         interpret = jax.default_backend() == "cpu"
 
         def hist_leaf(leaf_id, target):
-            return psum(leaf_histogram_masked(
+            return hist_psum(leaf_histogram_masked(
                 bins_t, gh8, leaf_id, bag_i32, target,
                 max_bin=max_bin, interpret=interpret).astype(dtype))
     else:
         def hist_leaf(leaf_id, target):
             gv = make_gvals(grad, hess, (leaf_id == target) & bag_mask, dtype)
-            return psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
-                                       row_chunk=row_chunk))
+            return hist_psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
+                                            row_chunk=row_chunk))
 
     def depth_gated(gain, depth):
         if max_depth > 0:
@@ -144,16 +228,20 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     # ---- root ----
     root_hist = hist_leaf(jnp.zeros(n, dtype=jnp.int32), jnp.int32(0))
     # every row lands in exactly one bin of feature 0, so its histogram sums
-    # are the root totals (LeafSplits::Init root sumup, leaf_splits.hpp:36-117)
+    # are the root totals (LeafSplits::Init root sumup, leaf_splits.hpp:36-117);
+    # in voting mode the hist is local, so all-reduce the three scalars
+    # (the reference's root Allreduce, data_parallel_tree_learner.cpp:94-122)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
-    root_cnt = jnp.round(jnp.sum(root_hist[0, :, 2])).astype(jnp.int32)
+    root_c = jnp.sum(root_hist[0, :, 2])
+    if voting:
+        root_g, root_h, root_c = (psum(root_g), psum(root_h), psum(root_c))
+    root_cnt = jnp.round(root_c).astype(jnp.int32)
 
     tree = _empty_tree(max_leaves, dtype)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_cnt))
     best = _empty_best(max_leaves, dtype)
-    root_best = find_best_split(root_hist, root_cnt, root_g, root_h,
-                                feature_mask, params)
+    root_best = best_of(root_hist, root_cnt, root_g, root_h)
     root_best = root_best._replace(
         gain=depth_gated(root_best.gain, jnp.int32(1)))
     best = _set_best(best, 0, root_best)
@@ -214,7 +302,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # --- partition: one vectorized compare (replaces DataPartition::Split,
         # src/treelearner/data_partition.hpp:84-132) ---
-        binrow = bins_t[s.feature].astype(jnp.int32)
+        binrow = feature_bin_row(s.feature)
         go_right = (st.leaf_id == bl) & (binrow > s.threshold)
         leaf_id = jnp.where(go_right, right, st.leaf_id)
 
@@ -234,11 +322,10 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # --- best splits for the two children ---
         child_depth = new_tree.leaf_depth[bl]
-        lbest = find_best_split(left_hist, s.left_count, s.left_sum_g,
-                                s.left_sum_h, feature_mask, params)
+        lbest = best_of(left_hist, s.left_count, s.left_sum_g, s.left_sum_h)
         lbest = lbest._replace(gain=depth_gated(lbest.gain, child_depth))
-        rbest = find_best_split(right_hist, s.right_count, s.right_sum_g,
-                                s.right_sum_h, feature_mask, params)
+        rbest = best_of(right_hist, s.right_count, s.right_sum_g,
+                        s.right_sum_h)
         rbest = rbest._replace(gain=depth_gated(rbest.gain, child_depth))
         best = _set_best(_set_best(best, bl, lbest), right, rbest)
 

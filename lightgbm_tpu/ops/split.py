@@ -69,21 +69,13 @@ def leaf_output(sum_g, sum_h, l1: float, l2: float):
     return jnp.where(abs_g > l1, val, 0.0)
 
 
-def find_best_split(hist: jax.Array, leaf_count: jax.Array,
-                    sum_g: jax.Array, sum_h: jax.Array,
-                    feature_mask: jax.Array, params: SplitParams) -> BestSplit:
-    """Best split over one leaf's histograms.
-
-    hist:         [F, B, 3] (grad, hess, count) per (feature, bin)
-    leaf_count:   scalar i32 — rows in this leaf (bagged, or global when
-                  data-parallel, matching data_parallel_tree_learner.cpp:155-186)
-    sum_g/sum_h:  scalar leaf totals
-    feature_mask: [F] bool — feature_fraction sample for this tree
-    """
-    f, b, _ = hist.shape
-    dt = hist.dtype
+def _split_scan(hist: jax.Array, leaf_count, sum_g, sum_h,
+                feature_mask: jax.Array, params: SplitParams):
+    """The suffix-sum threshold scan shared by the serial argmax and the
+    voting learner's per-feature vote.  Returns per-(feature, bin) arrays:
+    (masked_gains, left_g, left_h, left_cnt, right_g, right_h, right_cnt,
+    gain_shift)."""
     l1, l2 = params.lambda_l1, params.lambda_l2
-
     g = hist[:, :, 0]
     h = hist[:, :, 1]
     c = hist[:, :, 2]
@@ -114,12 +106,52 @@ def find_best_split(hist: jax.Array, leaf_count: jax.Array,
     valid = valid & feature_mask[:, None]
 
     masked_gains = jnp.where(valid, gains, K_MIN_SCORE)
+    return (masked_gains, left_g, left_h, left_cnt, right_g, right_h,
+            right_cnt, gain_shift)
 
-    # per-feature argmax with larger-t tie-break: argmax on reversed bins
+
+def _per_feature_argmax(masked_gains: jax.Array):
+    """Per-feature best threshold with the larger-t tie-break: argmax over
+    REVERSED bins (descending scan with strict `>` replacement keeps the
+    larger threshold, reference feature_histogram.hpp:148).
+    -> (best_gain [F], best_t [F])."""
+    b = masked_gains.shape[1]
     rev = masked_gains[:, ::-1]
     best_rev_idx = jnp.argmax(rev, axis=1)
-    best_t = b - 1 - best_rev_idx                       # [F]
-    best_gain_f = jnp.take_along_axis(masked_gains, best_t[:, None], axis=1)[:, 0]
+    best_t = b - 1 - best_rev_idx
+    best_gain_f = jnp.take_along_axis(masked_gains, best_t[:, None],
+                                      axis=1)[:, 0]
+    return best_gain_f, best_t
+
+
+def per_feature_best(hist: jax.Array, leaf_count, sum_g, sum_h,
+                     feature_mask: jax.Array, params: SplitParams):
+    """(best_gain [F], best_threshold_bin t [F]) per feature — the local
+    scoring pass of the voting learner (PV-Tree's local voting step)."""
+    masked_gains = _split_scan(hist, leaf_count, sum_g, sum_h,
+                               feature_mask, params)[0]
+    return _per_feature_argmax(masked_gains)
+
+
+def find_best_split(hist: jax.Array, leaf_count: jax.Array,
+                    sum_g: jax.Array, sum_h: jax.Array,
+                    feature_mask: jax.Array, params: SplitParams) -> BestSplit:
+    """Best split over one leaf's histograms.
+
+    hist:         [F, B, 3] (grad, hess, count) per (feature, bin)
+    leaf_count:   scalar i32 — rows in this leaf (bagged, or global when
+                  data-parallel, matching data_parallel_tree_learner.cpp:155-186)
+    sum_g/sum_h:  scalar leaf totals
+    feature_mask: [F] bool — feature_fraction sample for this tree
+    """
+    dt = hist.dtype
+    l1, l2 = params.lambda_l1, params.lambda_l2
+
+    (masked_gains, left_g, left_h, left_cnt, right_g, right_h, right_cnt,
+     gain_shift) = _split_scan(hist, leaf_count, sum_g, sum_h,
+                               feature_mask, params)
+
+    best_gain_f, best_t = _per_feature_argmax(masked_gains)
 
     # across features: first max = smaller feature index
     best_f = jnp.argmax(best_gain_f).astype(jnp.int32)

@@ -32,33 +32,49 @@ from ..ops.grow import TreeArrays, grow_tree
 from ..ops.split import SplitParams
 
 DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
 
 
-def make_mesh(num_shards: int = 0) -> Mesh:
+def make_mesh(num_shards: int = 0, axis: str = DATA_AXIS) -> Mesh:
     devs = jax.devices()
     if num_shards <= 0:
         num_shards = len(devs)
     if num_shards > len(devs):
         raise ValueError("num_shards=%d > %d available devices"
                          % (num_shards, len(devs)))
-    return Mesh(np.array(devs[:num_shards]), (DATA_AXIS,))
+    return Mesh(np.array(devs[:num_shards]), (axis,))
 
 
 def padded_size(n: int, num_shards: int) -> int:
     return ((n + num_shards - 1) // num_shards) * num_shards
 
 
+def _pad_rows_and_put(arr: np.ndarray, n_pad: int, fill, mesh: Mesh,
+                      spec: P) -> jax.Array:
+    """Pad the last (row) axis to n_pad and place with the given spec."""
+    pad = n_pad - arr.shape[-1]
+    if pad:
+        arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)],
+                     constant_values=fill)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
 class ShardedGrower:
-    """Grows trees with rows sharded over the mesh's data axis."""
+    """Grows trees with rows sharded over the mesh's data axis.
+
+    voting_top_k > 0 switches the per-split histogram all-reduce to the
+    PV-Tree voting protocol (tree_learner=voting, ops/grow.py)."""
 
     def __init__(self, mesh: Mesh, *, max_leaves: int, max_bin: int,
                  params: SplitParams, max_depth: int = -1,
-                 row_chunk: int = 0, hist_impl: str = "xla"):
+                 row_chunk: int = 0, voting_top_k: int = 0,
+                 hist_impl: str = "xla"):
         self.mesh = mesh
         self.num_shards = mesh.devices.size
         kw = dict(max_leaves=max_leaves, max_bin=max_bin, params=params,
                   max_depth=max_depth, row_chunk=row_chunk,
-                  psum_axis=DATA_AXIS, hist_impl=hist_impl)
+                  psum_axis=DATA_AXIS, voting_top_k=voting_top_k,
+                  hist_impl=hist_impl)
         fn = functools.partial(grow_tree, **kw)
         tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
         self._grow = jax.jit(jax.shard_map(
@@ -87,12 +103,67 @@ class ShardedGrower:
         return jax.device_put(bins, self.bins_sharding())
 
     def shard_rows(self, arr: np.ndarray, n_pad: int, fill=0) -> jax.Array:
-        pad = n_pad - arr.shape[-1]
-        if pad:
-            arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)],
-                         constant_values=fill)
-        return jax.device_put(arr, NamedSharding(
-            self.mesh, P(*([None] * (arr.ndim - 1) + [DATA_AXIS]))))
+        return _pad_rows_and_put(
+            arr, n_pad, fill, self.mesh,
+            P(*([None] * (arr.ndim - 1) + [DATA_AXIS])))
 
     def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
         return self._grow(bins_dev, grad, hess, bag_mask, feature_mask)
+
+
+class FeatureShardedGrower:
+    """Grows trees with FEATURES sharded over the mesh (tree_learner=
+    feature).
+
+    TPU-native equivalent of FeatureParallelTreeLearner (reference
+    src/treelearner/feature_parallel_tree_learner.cpp): every device holds
+    all rows (grad/hess/bag replicated), the [F, N] bin matrix is split
+    along F, each shard scans best splits only for its features, and an
+    all-gather + deterministic argmax replaces Allreduce(MaxReducer).
+    The reference's greedy bin-count load balancing (:26-43) is unneeded:
+    shards carry equal feature counts and the scan is vectorized.
+    """
+
+    def __init__(self, mesh: Mesh, *, max_leaves: int, max_bin: int,
+                 params: SplitParams, max_depth: int = -1,
+                 row_chunk: int = 0, hist_impl: str = "xla"):
+        self.mesh = mesh
+        self.num_shards = mesh.devices.size
+        kw = dict(max_leaves=max_leaves, max_bin=max_bin, params=params,
+                  max_depth=max_depth, row_chunk=row_chunk,
+                  feature_axis=FEATURE_AXIS, hist_impl=hist_impl)
+        fn = functools.partial(grow_tree, **kw)
+        tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
+        self._grow = jax.jit(jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(FEATURE_AXIS, None), P(None), P(None),
+                      P(None), P(FEATURE_AXIS)),
+            out_specs=(tree_specs, P(None)),
+            check_vma=False))
+
+    def padded_features(self, f: int) -> int:
+        return padded_size(f, self.num_shards)
+
+    def shard_bins(self, bins: np.ndarray) -> jax.Array:
+        """Pad F to a multiple of the shard count (padded features have
+        all-zero bins and a False feature_mask) and place split on F."""
+        f, n = bins.shape
+        pad = self.padded_features(f) - f
+        if pad:
+            bins = np.pad(bins, ((0, pad), (0, 0)))
+        return jax.device_put(
+            bins, NamedSharding(self.mesh, P(FEATURE_AXIS, None)))
+
+    def shard_rows(self, arr: np.ndarray, n_pad: int, fill=0) -> jax.Array:
+        """Rows are replicated under feature parallelism; pad and place."""
+        return _pad_rows_and_put(arr, n_pad, fill, self.mesh,
+                                 P(*([None] * arr.ndim)))
+
+    def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
+        fmask = np.asarray(feature_mask)
+        pad = self.padded_features(len(fmask)) - len(fmask)
+        if pad:
+            fmask = np.pad(fmask, (0, pad))
+        fmask = jax.device_put(
+            fmask, NamedSharding(self.mesh, P(FEATURE_AXIS)))
+        return self._grow(bins_dev, grad, hess, bag_mask, fmask)

@@ -127,3 +127,154 @@ def test_end_to_end_data_parallel_training():
     m.init("train", ds.metadata, n)
     auc = m.eval(np.asarray(booster._training_score()))[0]
     assert auc > 0.95
+
+
+@pytest.mark.parametrize("f", [6, 5])  # f=5 exercises feature padding (8 shards)
+def test_feature_sharded_tree_identical_to_serial(f):
+    """tree_learner=feature invariant (reference
+    feature_parallel_tree_learner.cpp:45-78): per-shard best-split scan +
+    MaxReducer-style combine must reproduce the serial tree exactly."""
+    from lightgbm_tpu.parallel.mesh import FeatureShardedGrower, FEATURE_AXIS
+
+    n = 1000
+    bins_t, grad, hess = make_data(n=n, f=f, seed=5)
+    serial_tree, serial_leaf = grow_tree(
+        jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, dtype=bool), jnp.ones(f, dtype=bool),
+        max_leaves=15, max_bin=32, params=PARAMS)
+
+    mesh = make_mesh(8, FEATURE_AXIS)
+    grower = FeatureShardedGrower(mesh, max_leaves=15, max_bin=32,
+                                  params=PARAMS)
+    sh_tree, sh_leaf = grower.grow(
+        grower.shard_bins(bins_t),
+        grower.shard_rows(grad, n), grower.shard_rows(hess, n),
+        grower.shard_rows(np.ones(n, dtype=bool), n),
+        np.ones(f, dtype=bool))
+
+    nl = int(serial_tree.num_leaves)
+    assert int(sh_tree.num_leaves) == nl
+    np.testing.assert_array_equal(np.asarray(sh_tree.split_feature)[:nl - 1],
+                                  np.asarray(serial_tree.split_feature)[:nl - 1])
+    np.testing.assert_array_equal(np.asarray(sh_tree.threshold_bin)[:nl - 1],
+                                  np.asarray(serial_tree.threshold_bin)[:nl - 1])
+    np.testing.assert_allclose(np.asarray(sh_tree.leaf_value)[:nl],
+                               np.asarray(serial_tree.leaf_value)[:nl],
+                               rtol=1e-9)
+    np.testing.assert_array_equal(np.asarray(sh_leaf), np.asarray(serial_leaf))
+
+
+def test_end_to_end_feature_parallel_training():
+    """Full GBDT loop with tree_learner=feature on the virtual mesh,
+    tree-identical to the serial learner."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset, Metadata
+    from lightgbm_tpu.io.binning import find_bins
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(7)
+    n, ncol = 800, 7
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] - 0.7 * x[:, 2] > 0).astype(np.float64)
+
+    def build(tl):
+        cfg = Config.from_params({
+            "objective": "binary", "tree_learner": tl, "num_leaves": "8",
+            "min_data_in_leaf": "10", "min_sum_hessian_in_leaf": "1",
+            "num_iterations": "3", "metric": "", "num_shards": "8"})
+        mappers = find_bins(x, n, cfg.max_bin)
+        bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
+                         for j, m in enumerate(mappers)])
+        ds = Dataset(bins=bins, bin_mappers=mappers,
+                     used_feature_map=np.arange(ncol, dtype=np.int32),
+                     real_feature_index=np.arange(ncol, dtype=np.int32),
+                     num_total_features=ncol,
+                     feature_names=["Column_%d" % i for i in range(ncol)],
+                     metadata=Metadata(label=y.astype(np.float32)))
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, n)
+        b = create_boosting(cfg, ds, obj)
+        for _ in range(3):
+            b.train_one_iter(None, None, False)
+        return b
+
+    b_feat = build("feature")
+    b_serial = build("serial")
+    assert len(b_feat.models) == 3
+    for tf, ts in zip(b_feat.models, b_serial.models):
+        assert tf.num_leaves == ts.num_leaves
+        np.testing.assert_array_equal(tf.split_feature_real[:tf.num_leaves - 1],
+                                      ts.split_feature_real[:ts.num_leaves - 1])
+        np.testing.assert_allclose(tf.leaf_value[:tf.num_leaves],
+                                   ts.leaf_value[:ts.num_leaves], rtol=1e-6)
+
+
+def test_voting_parallel_matches_data_parallel_when_k_covers_features():
+    """With top_k >= F every feature is always a candidate, so voting must
+    reproduce the exact data-parallel (and serial) tree."""
+    n, f = 1000, 6
+    bins_t, grad, hess = make_data(n=n, f=f, seed=11)
+    serial_tree, serial_leaf = grow_tree(
+        jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, dtype=bool), jnp.ones(f, dtype=bool),
+        max_leaves=15, max_bin=32, params=PARAMS)
+
+    mesh = make_mesh(8)
+    grower = ShardedGrower(mesh, max_leaves=15, max_bin=32, params=PARAMS,
+                           voting_top_k=f)
+    bins_dev = grower.shard_bins(bins_t)
+    v_tree, v_leaf = grower.grow(
+        bins_dev, grower.shard_rows(grad, n), grower.shard_rows(hess, n),
+        grower.shard_rows(np.ones(n, dtype=bool), n),
+        jnp.ones(f, dtype=bool))
+    nl = int(serial_tree.num_leaves)
+    assert int(v_tree.num_leaves) == nl
+    np.testing.assert_array_equal(np.asarray(v_tree.split_feature)[:nl - 1],
+                                  np.asarray(serial_tree.split_feature)[:nl - 1])
+    np.testing.assert_array_equal(np.asarray(v_tree.threshold_bin)[:nl - 1],
+                                  np.asarray(serial_tree.threshold_bin)[:nl - 1])
+    np.testing.assert_allclose(np.asarray(v_tree.leaf_value)[:nl],
+                               np.asarray(serial_tree.leaf_value)[:nl],
+                               rtol=1e-9)
+    np.testing.assert_array_equal(np.asarray(v_leaf)[:n],
+                                  np.asarray(serial_leaf))
+
+
+def test_voting_parallel_small_k_trains_well():
+    """With top_k < F the vote restricts candidates (approximate), but the
+    model must still learn the signal (PV-Tree's accuracy claim)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset, Metadata
+    from lightgbm_tpu.io.binning import find_bins
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.metrics import AUCMetric
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(13)
+    n, ncol = 800, 10
+    x = rng.randn(n, ncol)
+    y = (x[:, 4] + 0.5 * x[:, 8] > 0).astype(np.float64)
+    cfg = Config.from_params({
+        "objective": "binary", "tree_learner": "voting", "top_k": "2",
+        "num_leaves": "8", "min_data_in_leaf": "10",
+        "min_sum_hessian_in_leaf": "1", "metric": "", "num_shards": "8"})
+    assert cfg.tree_learner == "voting" and cfg.is_parallel
+    mappers = find_bins(x, n, cfg.max_bin)
+    bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
+                     for j, m in enumerate(mappers)])
+    ds = Dataset(bins=bins, bin_mappers=mappers,
+                 used_feature_map=np.arange(ncol, dtype=np.int32),
+                 real_feature_index=np.arange(ncol, dtype=np.int32),
+                 num_total_features=ncol,
+                 feature_names=["Column_%d" % i for i in range(ncol)],
+                 metadata=Metadata(label=y.astype(np.float32)))
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, n)
+    booster = create_boosting(cfg, ds, obj)
+    for _ in range(5):
+        booster.train_one_iter(None, None, False)
+    m = AUCMetric(cfg)
+    m.init("train", ds.metadata, n)
+    auc = m.eval(np.asarray(booster._training_score()))[0]
+    assert auc > 0.95
