@@ -31,8 +31,20 @@ class BinMapper:
 
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
         """Vectorized ValueToBin (reference include/LightGBM/bin.h:296-309):
-        first bin whose upper bound >= value."""
-        return np.searchsorted(self.bin_upper_bound, values, side="left")
+        first bin whose upper bound >= value.  Uses the native binning
+        kernel (native/ingest.cpp lgt_bin_values) when available."""
+        if self.num_bin <= 256:
+            from .. import native
+            out = native.bin_values(np.asarray(values, dtype=np.float64),
+                                    self.bin_upper_bound)
+            if out is not None:
+                return out
+        # clip: NaN fails every comparison and must land in the LAST bin
+        # exactly like the reference's binary search (bin.h:296-309) and
+        # the native kernel (searchsorted would return num_bin)
+        return np.minimum(
+            np.searchsorted(self.bin_upper_bound, values, side="left"),
+            self.num_bin - 1)
 
 
 def find_bin(sample_values: np.ndarray, total_sample_cnt: int,

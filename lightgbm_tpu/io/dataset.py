@@ -29,7 +29,7 @@ import numpy as np
 from ..utils import log
 from ..config import Config
 from .binning import BinMapper, find_bin
-from .parser import parse_file_lines
+from .parser import parse_file_bytes
 
 _BIN_CACHE_VERSION = 1
 
@@ -140,21 +140,23 @@ def load_dataset(filename: str, config: Config,
         except Exception as e:  # corrupt/stale cache: fall through to text
             log.warning("Failed to load binary cache %s: %s" % (cache, e))
 
-    with open(filename) as f:
-        lines = f.read().splitlines()
-    lines = [ln for ln in lines if ln.strip()]
+    with open(filename, "rb") as f:
+        raw = f.read()
 
     names: List[str] = []
-    if config.has_header and lines:
-        first_sep = "\t" if "\t" in lines[0] else ","
-        names = lines[0].split(first_sep)
-        lines = lines[1:]
+    if config.has_header and raw:
+        nl = raw.find(b"\n")
+        first = raw[:nl if nl >= 0 else len(raw)].decode(
+            "utf-8", "replace").strip()
+        raw = raw[nl + 1:] if nl >= 0 else b""
+        first_sep = "\t" if "\t" in first else ","
+        names = first.split(first_sep)
 
     label_idx = _parse_column_spec(config.label_column, names)
     if label_idx < 0:
         label_idx = 0
 
-    label, feats, fmt = parse_file_lines(lines, label_idx)
+    label, feats, fmt = parse_file_bytes(raw, label_idx)
     n_total = len(label)
 
     if num_shards > 1 and not config.is_pre_partition:

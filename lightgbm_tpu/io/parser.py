@@ -36,13 +36,18 @@ def detect_format(sample_lines: List[str]) -> str:
 
 
 def _clean_token(tok: str) -> float:
+    """Reference Atof token semantics (common.h:200-290): na/nan/empty -> 0
+    (null accepted as an extension), inf -> +-1e308, unknown -> fatal."""
     t = tok.strip().lower()
-    if t in ("na", "nan", "null"):
-        return 0.0  # reference Atof maps na/nan to 0 via NaN handling in push
+    if t in ("", "na", "nan", "null"):
+        return 0.0
     try:
-        return float(t)
+        v = float(t)
     except ValueError:
-        log.fatal("Failed to parse value '%s'" % tok)
+        log.fatal("Unknown token %s in data file" % tok)
+    if v != v:
+        return 0.0
+    return min(max(v, -1e308), 1e308)
 
 
 def parse_dense(lines: List[str], sep: str, label_idx: int
@@ -61,8 +66,9 @@ def parse_dense(lines: List[str], sep: str, label_idx: int
         data = np.empty((len(rows), ncol), dtype=np.float64)
         for i, toks in enumerate(rows):
             data[i] = [_clean_token(t) for t in toks[:ncol]]
-    if np.isnan(data).any():
-        data = np.nan_to_num(data, nan=0.0)
+    if not np.isfinite(data).all():
+        # nan -> 0 and inf -> +-1e308, like the reference Atof
+        data = np.nan_to_num(data, nan=0.0, posinf=1e308, neginf=-1e308)
     label = data[:, label_idx].copy()
     feats = np.delete(data, label_idx, axis=1)
     return label, feats
@@ -95,6 +101,25 @@ def parse_libsvm(lines: List[str], label_idx: int
     return label, feats
 
 
+def _native_parse(lines: List[str], label_idx: int, fmt: str):
+    """Single-pass C++ parser (native/ingest.cpp); None -> fall back."""
+    from .. import native
+    if native.get_lib() is None:
+        return None
+    text = "\n".join(lines).encode("utf-8", errors="replace")
+    if fmt in ("tsv", "csv"):
+        data = native.parse_dense(text, "\t" if fmt == "tsv" else ",")
+        if data is None or data.shape[0] != len(lines):
+            return None
+        label = data[:, label_idx].copy()
+        feats = np.delete(data, label_idx, axis=1)
+        return label, feats
+    out = native.parse_libsvm(text)
+    if out is None or len(out[0]) != len(lines):
+        return None
+    return out
+
+
 def parse_file_lines(lines: List[str], label_idx: int,
                      fmt: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray, str]:
@@ -102,6 +127,9 @@ def parse_file_lines(lines: List[str], label_idx: int,
     if not lines:
         log.fatal("Data file is empty")
     fmt = fmt or detect_format(lines)
+    nat = _native_parse(lines, label_idx, fmt)
+    if nat is not None:
+        return nat[0], nat[1], fmt
     if fmt == "tsv":
         label, feats = parse_dense(lines, "\t", label_idx)
     elif fmt == "csv":
@@ -109,3 +137,34 @@ def parse_file_lines(lines: List[str], label_idx: int,
     else:
         label, feats = parse_libsvm(lines, label_idx)
     return label, feats, fmt
+
+
+def parse_file_bytes(raw: bytes, label_idx: int,
+                     fmt: Optional[str] = None
+                     ) -> Tuple[np.ndarray, np.ndarray, str]:
+    """Parse a whole data file from its raw bytes.
+
+    The zero-extra-copy ingest path: the native parser consumes `raw`
+    directly (its scan already skips blank lines), so no join/encode
+    round-trips happen on the TB-scale path; without the native library we
+    decode once and take the line-based fallback.
+    """
+    head = [ln for ln in raw[:65536].decode("utf-8", "replace").splitlines()
+            if ln.strip()]
+    if not head:
+        log.fatal("Data file is empty")
+    fmt = fmt or detect_format(head[:2])
+    from .. import native
+    if native.get_lib() is not None:
+        if fmt in ("tsv", "csv"):
+            data = native.parse_dense(raw, "\t" if fmt == "tsv" else ",")
+            if data is not None and data.size:
+                label = data[:, label_idx].copy()
+                feats = np.delete(data, label_idx, axis=1)
+                return label, feats, fmt
+        else:
+            out = native.parse_libsvm(raw)
+            if out is not None:
+                return out[0], out[1], fmt
+    lines = raw.decode("utf-8", errors="replace").splitlines()
+    return parse_file_lines(lines, label_idx, fmt)

@@ -1,0 +1,136 @@
+"""Native (C++) ingest runtime, built on demand and loaded via ctypes.
+
+The reference's IO layer is C++ (src/io/parser.*, dataset_loader.cpp); this
+is its native-equivalent here: a single-pass text parser + binning kernel
+compiled from ingest.cpp with the system g++ the first time it is needed.
+No pybind11 in this image, so the binding is plain ctypes over an
+extern "C" surface.
+
+Set LGBM_TPU_NO_NATIVE=1 to force the pure-Python fallbacks (io/parser.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ingest.cpp")
+_SO = os.path.join(_HERE, "_ingest.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return out.returncode == 0 and os.path.exists(_SO)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if stale/absent; None when
+    disabled or the toolchain is unavailable (callers fall back to numpy)."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LGBM_TPU_NO_NATIVE"):
+        return None
+    try:
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+
+    i64 = ctypes.c_int64
+    pi64 = ctypes.POINTER(ctypes.c_int64)
+    pd = ctypes.POINTER(ctypes.c_double)
+    pu8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.lgt_scan_dense.argtypes = [ctypes.c_char_p, i64, ctypes.c_char,
+                                   pi64, pi64]
+    lib.lgt_scan_dense.restype = None
+    lib.lgt_parse_dense.argtypes = [ctypes.c_char_p, i64, ctypes.c_char,
+                                    pd, i64, i64]
+    lib.lgt_parse_dense.restype = i64
+    lib.lgt_scan_libsvm.argtypes = [ctypes.c_char_p, i64, pi64, pi64]
+    lib.lgt_scan_libsvm.restype = None
+    lib.lgt_parse_libsvm.argtypes = [ctypes.c_char_p, i64, pd, pd, i64, i64]
+    lib.lgt_parse_libsvm.restype = i64
+    lib.lgt_bin_values.argtypes = [pd, i64, pd, ctypes.c_int32, pu8]
+    lib.lgt_bin_values.restype = None
+    _lib = lib
+    return _lib
+
+
+def _dbl_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def parse_dense(text: bytes, sep: str) -> Optional[np.ndarray]:
+    """text -> [rows, cols] f64, or None when native is unavailable.
+    Raises on malformed tokens (reference Atof Log::Fatal,
+    common.h:283-286)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    lib.lgt_scan_dense(text, len(text), sep.encode()[0],
+                       ctypes.byref(rows), ctypes.byref(cols))
+    if rows.value == 0:
+        return np.zeros((0, 0), dtype=np.float64)
+    out = np.empty((rows.value, cols.value), dtype=np.float64)
+    got = lib.lgt_parse_dense(text, len(text), sep.encode()[0],
+                              _dbl_ptr(out), rows.value, cols.value)
+    if got < 0:
+        from ..utils import log
+        log.fatal("Unknown token in data file at row %d" % (-got - 1))
+    return out[:got]
+
+
+def parse_libsvm(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """text -> (label [N], feats [N, max_idx+1]) f64, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    max_idx = ctypes.c_int64()
+    lib.lgt_scan_libsvm(text, len(text), ctypes.byref(rows),
+                        ctypes.byref(max_idx))
+    n, ncols = rows.value, max_idx.value + 1
+    label = np.empty(n, dtype=np.float64)
+    feats = np.zeros((n, max(ncols, 0)), dtype=np.float64)
+    if n:
+        got = lib.lgt_parse_libsvm(text, len(text), _dbl_ptr(label),
+                                   _dbl_ptr(feats), n, ncols)
+        if got < 0:
+            from ..utils import log
+            log.fatal("Unknown token in data file at row %d" % (-got - 1))
+        label, feats = label[:got], feats[:got]
+    return label, feats
+
+
+def bin_values(vals: np.ndarray, bounds: np.ndarray
+               ) -> Optional[np.ndarray]:
+    """Binary-search binning (BinMapper::ValueToBin) -> uint8 bins."""
+    lib = get_lib()
+    if lib is None or len(bounds) > 256:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    bounds = np.ascontiguousarray(bounds, dtype=np.float64)
+    out = np.empty(len(vals), dtype=np.uint8)
+    lib.lgt_bin_values(_dbl_ptr(vals), len(vals), _dbl_ptr(bounds),
+                       np.int32(len(bounds)),
+                       out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

@@ -1,0 +1,202 @@
+// Native ingest: single-pass text -> dense double matrix, plus binning.
+//
+// The native counterpart of the reference's hand-rolled parsers
+// (reference src/io/parser.hpp:15-109, parser.cpp) and of the
+// Feature::PushData binning path (include/LightGBM/feature.h:72-75,
+// bin.h:296-309 ValueToBin binary search) — re-designed for the TPU
+// framework's ingest shape: the output is one row-major [rows, cols]
+// double buffer (numpy-owned) that host-side binning turns into the
+// [F, N] uint8 HBM matrix, not per-feature push targets.
+//
+// Token semantics match the Python fallback (io/parser.py) and the
+// reference's Atof (include/LightGBM/utils/common.h:89-199): na / nan /
+// null / empty -> 0.0, inf/-inf via strtod, short rows zero-filled.
+//
+// Built lazily by lightgbm_tpu/native/__init__.py with
+//   g++ -O3 -shared -fPIC -std=c++17 ingest.cpp -o _ingest.so
+// and loaded through ctypes (no pybind11 in this image).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+
+// Token semantics of the reference Atof (common.h:200-290): numbers via
+// strtod; "nan"/"na"/"null"/empty -> 0; inf -> +-1e308; anything else is
+// a parse error (*ok = false), matching the Python fallback's fatal.
+inline double parse_value(const char* p, const char* end, const char** out,
+                          bool* ok) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;  // leading pad (libsvm)
+  char* q = nullptr;
+  double v = std::strtod(p, &q);
+  if (q == p) {  // not numeric: token path
+    const char* s = p;
+    while (s < end && !is_eol(*s) && *s != ',' && *s != '\t' && *s != ' ' &&
+           *s != ':')
+      ++s;
+    *out = s;
+    size_t n = static_cast<size_t>(s - p);
+    char t[5] = {0, 0, 0, 0, 0};
+    for (size_t i = 0; i < n && i < 4; ++i) t[i] = std::tolower(p[i]);
+    if (n == 0 || (n == 2 && !std::strcmp(t, "na")) ||
+        (n == 3 && !std::strcmp(t, "nan")) ||
+        (n == 4 && !std::strcmp(t, "null")))
+      return 0.0;
+    *ok = false;
+    return 0.0;
+  }
+  if (v != v) v = 0.0;          // "nan" via strtod -> 0 like the reference
+  if (v > 1e308) v = 1e308;     // "inf" -> +-1e308 (common.h:284)
+  if (v < -1e308) v = -1e308;
+  *out = q;
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count rows (non-empty lines) and columns (separators in the first
+// non-empty line + 1) of a dense CSV/TSV buffer.
+void lgt_scan_dense(const char* buf, int64_t len, char sep,
+                    int64_t* rows_out, int64_t* cols_out) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t rows = 0, cols = 0;
+  while (p < end) {
+    const char* line = p;
+    while (p < end && !is_eol(*p)) ++p;
+    if (p > line) {  // non-empty
+      if (rows == 0) {
+        cols = 1;
+        for (const char* s = line; s < p; ++s)
+          if (*s == sep) ++cols;
+      }
+      ++rows;
+    }
+    while (p < end && is_eol(*p)) ++p;
+  }
+  *rows_out = rows;
+  *cols_out = cols;
+}
+
+// Fill a row-major [rows, cols] buffer from a dense CSV/TSV text.
+// Missing trailing fields are 0-filled; extra fields are ignored.
+// Returns the number of rows written, or -(row+1) on a parse error.
+int64_t lgt_parse_dense(const char* buf, int64_t len, char sep, double* out,
+                        int64_t rows, int64_t cols) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t r = 0;
+  bool ok = true;
+  while (p < end && r < rows) {
+    while (p < end && is_eol(*p)) ++p;
+    if (p >= end) break;
+    const char* line_end = p;
+    while (line_end < end && !is_eol(*line_end)) ++line_end;
+    if (line_end == p) continue;
+    double* row = out + r * cols;
+    int64_t c = 0;
+    while (p < line_end && c < cols) {
+      row[c++] = parse_value(p, line_end, &p, &ok);
+      if (!ok) return -(r + 1);
+      while (p < line_end && *p != sep) ++p;  // skip to separator
+      if (p < line_end) ++p;                  // past separator
+    }
+    for (; c < cols; ++c) row[c] = 0.0;
+    p = line_end;
+    ++r;
+  }
+  return r;
+}
+
+// Scan a libsvm buffer: rows and the maximum feature index seen.
+void lgt_scan_libsvm(const char* buf, int64_t len, int64_t* rows_out,
+                     int64_t* max_idx_out) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t rows = 0, max_idx = -1;
+  while (p < end) {
+    const char* line_end = p;
+    while (line_end < end && !is_eol(*line_end)) ++line_end;
+    if (line_end > p) {
+      ++rows;
+      for (const char* s = p; s < line_end; ++s) {
+        if (*s == ':') {
+          const char* b = s;
+          while (b > p && b[-1] >= '0' && b[-1] <= '9') --b;
+          if (b < s) {
+            int64_t idx = std::strtoll(b, nullptr, 10);
+            if (idx > max_idx) max_idx = idx;
+          }
+        }
+      }
+    }
+    p = line_end;
+    while (p < end && is_eol(*p)) ++p;
+  }
+  *rows_out = rows;
+  *max_idx_out = max_idx;
+}
+
+// Fill label [rows] + dense feats [rows, ncols] from a libsvm buffer
+// (0-based indices like the reference LibSVMParser, src/io/parser.hpp:80-109).
+int64_t lgt_parse_libsvm(const char* buf, int64_t len, double* label_out,
+                         double* feats_out, int64_t rows, int64_t ncols) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t r = 0;
+  bool ok = true;
+  std::memset(feats_out, 0, sizeof(double) * rows * ncols);
+  while (p < end && r < rows) {
+    while (p < end && is_eol(*p)) ++p;
+    if (p >= end) break;
+    const char* line_end = p;
+    while (line_end < end && !is_eol(*line_end)) ++line_end;
+    if (line_end == p) continue;
+    label_out[r] = parse_value(p, line_end, &p, &ok);
+    if (!ok) return -(r + 1);
+    double* row = feats_out + r * ncols;
+    while (p < line_end) {
+      while (p < line_end && (*p == ' ' || *p == '\t')) ++p;
+      if (p >= line_end) break;
+      char* q = nullptr;
+      long long idx = std::strtoll(p, &q, 10);
+      if (q == p || q >= line_end || *q != ':') {  // skip malformed token
+        while (p < line_end && *p != ' ' && *p != '\t') ++p;
+        continue;
+      }
+      p = q + 1;  // past ':'
+      double v = parse_value(p, line_end, &p, &ok);
+      if (!ok) return -(r + 1);
+      if (idx >= 0 && idx < ncols) row[idx] = v;
+    }
+    p = line_end;
+    ++r;
+  }
+  return r;
+}
+
+// value -> bin: upper-bound binary search over bin_upper_bound, exactly
+// BinMapper::ValueToBin (reference include/LightGBM/bin.h:296-309).
+void lgt_bin_values(const double* vals, int64_t n, const double* bounds,
+                    int32_t num_bin, uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    double v = vals[i];
+    int32_t lo = 0, hi = num_bin - 1;
+    while (lo < hi) {
+      int32_t mid = (lo + hi) >> 1;
+      if (v <= bounds[mid])
+        hi = mid;
+      else
+        lo = mid + 1;
+    }
+    out[i] = static_cast<uint8_t>(lo);
+  }
+}
+
+}  // extern "C"

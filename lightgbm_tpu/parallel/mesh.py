@@ -59,6 +59,16 @@ def _pad_rows_and_put(arr: np.ndarray, n_pad: int, fill, mesh: Mesh,
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
+def _sharded_grow_fn(mesh: Mesh, grow_kw: dict, in_specs, leaf_id_spec: P):
+    """jit(shard_map(grow_tree)) with replicated tree-array outputs — the
+    shared scaffolding of the row- and feature-sharded growers."""
+    fn = functools.partial(grow_tree, **grow_kw)
+    tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=(tree_specs, leaf_id_spec),
+                                 check_vma=False))
+
+
 class ShardedGrower:
     """Grows trees with rows sharded over the mesh's data axis.
 
@@ -75,14 +85,11 @@ class ShardedGrower:
                   max_depth=max_depth, row_chunk=row_chunk,
                   psum_axis=DATA_AXIS, voting_top_k=voting_top_k,
                   hist_impl=hist_impl)
-        fn = functools.partial(grow_tree, **kw)
-        tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
-        self._grow = jax.jit(jax.shard_map(
-            fn, mesh=mesh,
+        self._grow = _sharded_grow_fn(
+            mesh, kw,
             in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(None)),
-            out_specs=(tree_specs, P(DATA_AXIS)),
-            check_vma=False))
+            leaf_id_spec=P(DATA_AXIS))
 
     def bins_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(None, DATA_AXIS))

@@ -1,0 +1,91 @@
+"""Native (C++) ingest parity: the ctypes-loaded parser/binner must agree
+exactly with the pure-Python fallbacks on the reference example files and
+on synthetic edge cases (na/nan tokens, CRLF, short rows, libsvm gaps)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+from lightgbm_tpu.io import parser as pyparser
+
+from conftest import REFERENCE_DIR
+
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native toolchain unavailable")
+
+
+def read_lines(path):
+    with open(path) as f:
+        return [ln for ln in f.read().splitlines() if ln.strip()]
+
+
+@pytest.mark.parametrize("example,fname", [
+    ("binary_classification", "binary.train"),
+    ("regression", "regression.test"),
+    ("lambdarank", "rank.test"),
+])
+def test_native_matches_python_on_examples(example, fname):
+    lines = read_lines(os.path.join(REFERENCE_DIR, "examples", example,
+                                    fname))
+    fmt = pyparser.detect_format(lines)
+    nat = pyparser._native_parse(lines, 0, fmt)
+    assert nat is not None, "native parse declined"
+    if fmt == "libsvm":
+        py_label, py_feats = pyparser.parse_libsvm(lines, 0)
+    else:
+        py_label, py_feats = pyparser.parse_dense(
+            lines, "\t" if fmt == "tsv" else ",", 0)
+    np.testing.assert_array_equal(nat[0], py_label)
+    np.testing.assert_array_equal(nat[1], py_feats)
+
+
+def test_native_dense_token_edge_cases():
+    lines = ["1.5,na,3", "nan,2.25,-inf", "0,null,1e3", "2,,7"]
+    nat = pyparser._native_parse(lines, 0, "csv")
+    assert nat is not None
+    label, feats = nat
+    np.testing.assert_array_equal(label, [1.5, 0.0, 0.0, 2.0])
+    np.testing.assert_array_equal(
+        feats, [[0.0, 3.0], [2.25, -np.inf], [0.0, 1e3], [0.0, 7.0]])
+
+
+def test_native_dense_short_rows():
+    lines = ["1\t2\t3", "4\t5"]
+    nat = pyparser._native_parse(lines, 0, "tsv")
+    label, feats = nat
+    np.testing.assert_array_equal(label, [1.0, 4.0])
+    np.testing.assert_array_equal(feats, [[2.0, 3.0], [5.0, 0.0]])
+
+
+def test_native_libsvm_gaps_and_malformed():
+    lines = ["1 0:1.5 3:2.5", "0 1:7", "-1 2:0.5 junk 4:1"]
+    nat = pyparser._native_parse(lines, 0, "libsvm")
+    label, feats = nat
+    np.testing.assert_array_equal(label, [1.0, 0.0, -1.0])
+    assert feats.shape == (3, 5)
+    np.testing.assert_array_equal(
+        feats, [[1.5, 0, 0, 2.5, 0], [0, 7, 0, 0, 0], [0, 0, 0.5, 0, 1]])
+
+
+def test_native_bin_values_matches_searchsorted():
+    rng = np.random.RandomState(0)
+    bounds = np.sort(rng.randn(63))
+    bounds = np.concatenate([bounds, [np.inf]])
+    vals = np.concatenate([rng.randn(10_000) * 2, bounds[:-1],  # exact hits
+                           [-1e30, 1e30]])
+    got = native.bin_values(vals, bounds)
+    assert got is not None and got.dtype == np.uint8
+    want = np.searchsorted(bounds, vals, side="left")
+    np.testing.assert_array_equal(got, want)
+
+
+def test_env_kill_switch(monkeypatch):
+    import importlib
+    monkeypatch.setenv("LGBM_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert native.get_lib() is None
+    monkeypatch.setattr(native, "_tried", False)  # restore for later tests
