@@ -181,6 +181,7 @@ class Config:
     num_shards: int = 0                   # 0 = all visible devices when tree_learner=data
     hist_dtype: str = "float32"           # histogram accumulator dtype
     hist_impl: str = "auto"               # auto | xla | pallas
+    hist_agg: str = "psum"                # psum | scatter (tree_learner=data)
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
@@ -317,6 +318,7 @@ class Config:
         set_int("top_k")
         set_str("hist_dtype")
         set_str("hist_impl")
+        set_str("hist_agg")
         set_bool("donate_buffers")
         set_str("device_type")
         if c.device_type not in ("", "cpu", "tpu"):
@@ -325,6 +327,9 @@ class Config:
         if c.hist_impl not in ("auto", "xla", "pallas"):
             log.fatal("Unknown hist_impl %s (expect auto|xla|pallas)"
                       % c.hist_impl)
+        if c.hist_agg not in ("psum", "scatter"):
+            log.fatal("Unknown hist_agg %s (expect psum|scatter)"
+                      % c.hist_agg)
         if c.hist_dtype not in ("float32", "float64"):
             log.fatal("Unknown hist_dtype %s (expect float32|float64)"
                       % c.hist_dtype)

@@ -144,13 +144,24 @@ def load_dataset(filename: str, config: Config,
         raw = f.read()
 
     names: List[str] = []
-    if config.has_header and raw:
-        nl = raw.find(b"\n")
-        first = raw[:nl if nl >= 0 else len(raw)].decode(
-            "utf-8", "replace").strip()
-        raw = raw[nl + 1:] if nl >= 0 else b""
-        first_sep = "\t" if "\t" in first else ","
-        names = first.split(first_sep)
+    if config.has_header:
+        # header = first non-blank line; scan by offset (no buffer copies),
+        # accepting \n, \r\n and bare-\r line endings
+        first = ""
+        off = 0
+        while off < len(raw) and not first:
+            nxt_n = raw.find(b"\n", off)
+            nxt_r = raw.find(b"\r", off)
+            ends = [e for e in (nxt_n, nxt_r) if e >= 0]
+            eol = min(ends) if ends else len(raw)
+            first = raw[off:eol].decode("utf-8", "replace").strip()
+            off = eol + 1
+            if off < len(raw) and raw[eol:eol + 2] == b"\r\n":
+                off += 1
+        raw = raw[off:] if off else raw
+        if first:
+            first_sep = "\t" if "\t" in first else ","
+            names = first.split(first_sep)
 
     label_idx = _parse_column_spec(config.label_column, names)
     if label_idx < 0:

@@ -65,7 +65,9 @@ def parse_dense(lines: List[str], sep: str, label_idx: int
         ncol = len(rows[0])
         data = np.empty((len(rows), ncol), dtype=np.float64)
         for i, toks in enumerate(rows):
-            data[i] = [_clean_token(t) for t in toks[:ncol]]
+            vals = [_clean_token(t) for t in toks[:ncol]]
+            vals.extend([0.0] * (ncol - len(vals)))  # short rows 0-filled
+            data[i] = vals
     if not np.isfinite(data).all():
         # nan -> 0 and inf -> +-1e308, like the reference Atof
         data = np.nan_to_num(data, nan=0.0, posinf=1e308, neginf=-1e308)
@@ -90,7 +92,12 @@ def parse_libsvm(lines: List[str], label_idx: int
             if ":" not in tok:
                 continue
             k, v = tok.split(":", 1)
-            idx = int(k)
+            try:
+                idx = int(k)
+            except ValueError:  # malformed index token: skip, like native
+                continue
+            if idx < 0:
+                continue
             pairs.append((idx, _clean_token(v)))
             max_idx = max(max_idx, idx)
         rows.append(pairs)

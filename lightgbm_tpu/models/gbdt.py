@@ -123,7 +123,7 @@ class GBDT:
                 max_depth=config.max_depth,
                 voting_top_k=(config.top_k
                               if config.tree_learner == "voting" else 0),
-                hist_impl=impl)
+                hist_impl=impl, hist_agg=config.hist_agg)
             row_unit *= self.grower.num_shards
             self.rows_sharded = True
         elif config.tree_learner == "feature":

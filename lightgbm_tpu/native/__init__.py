@@ -21,23 +21,38 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ingest.cpp")
 _SO = os.path.join(_HERE, "_ingest.so")
+_STAMP = _SO + ".src-sha256"
 
 _lib = None
 _tried = False
 
 
-def _build() -> bool:
+def _src_digest() -> str:
+    import hashlib
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(digest: str) -> bool:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
         return False
-    return out.returncode == 0 and os.path.exists(_SO)
+    if out.returncode != 0 or not os.path.exists(_SO):
+        return False
+    with open(_STAMP, "w") as f:
+        f.write(digest)
+    return True
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, building it if stale/absent; None when
-    disabled or the toolchain is unavailable (callers fall back to numpy)."""
+    disabled or the toolchain is unavailable (callers fall back to numpy).
+
+    Staleness is tracked by a content hash of ingest.cpp stamped next to
+    the .so (mtimes are unreliable after checkout); a load failure of an
+    existing .so (wrong arch, corrupt) falls back to rebuilding once."""
     global _lib, _tried
     if _tried:
         return _lib
@@ -45,13 +60,26 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if os.environ.get("LGBM_TPU_NO_NATIVE"):
         return None
     try:
-        stale = (not os.path.exists(_SO)
-                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if stale and not _build():
-            return None
-        lib = ctypes.CDLL(_SO)
+        digest = _src_digest()
     except OSError:
         return None
+    lib = None
+    try:
+        stamp = ""
+        if os.path.exists(_STAMP):
+            with open(_STAMP) as f:
+                stamp = f.read().strip()
+        if os.path.exists(_SO) and stamp == digest:
+            lib = ctypes.CDLL(_SO)
+    except OSError:
+        lib = None
+    if lib is None:
+        if not _build(digest):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
 
     i64 = ctypes.c_int64
     pi64 = ctypes.POINTER(ctypes.c_int64)

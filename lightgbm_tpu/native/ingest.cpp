@@ -20,40 +20,58 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 
 namespace {
 
 inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
 
-// Token semantics of the reference Atof (common.h:200-290): numbers via
-// strtod; "nan"/"na"/"null"/empty -> 0; inf -> +-1e308; anything else is
-// a parse error (*ok = false), matching the Python fallback's fatal.
-inline double parse_value(const char* p, const char* end, const char** out,
-                          bool* ok) {
-  while (p < end && (*p == ' ' || *p == '\t')) ++p;  // leading pad (libsvm)
-  char* q = nullptr;
-  double v = std::strtod(p, &q);
-  if (q == p) {  // not numeric: token path
-    const char* s = p;
-    while (s < end && !is_eol(*s) && *s != ',' && *s != '\t' && *s != ' ' &&
-           *s != ':')
-      ++s;
-    *out = s;
-    size_t n = static_cast<size_t>(s - p);
-    char t[5] = {0, 0, 0, 0, 0};
-    for (size_t i = 0; i < n && i < 4; ++i) t[i] = std::tolower(p[i]);
-    if (n == 0 || (n == 2 && !std::strcmp(t, "na")) ||
-        (n == 3 && !std::strcmp(t, "nan")) ||
-        (n == 4 && !std::strcmp(t, "null")))
-      return 0.0;
+inline bool in_set(const char* set, char c) {
+  for (const char* s = set; *s; ++s)
+    if (*s == c) return true;
+  return false;
+}
+
+// Token semantics of the reference Atof (common.h:200-290) and the Python
+// fallback's _clean_token (io/parser.py): the WHOLE token (up to the next
+// terminator in `terms` or EOL, whitespace-stripped) must be numeric, or
+// one of na/nan/null/empty -> 0; inf -> +-1e308; anything else is a parse
+// error (*ok = false).  Numbers are parsed with an explicit "C" locale so
+// an embedding process's setlocale() cannot change the decimal point.
+inline double parse_value(const char* p, const char* end, const char* terms,
+                          const char** out, bool* ok) {
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  const char* s = p;
+  while (s < end && !is_eol(*s) && !in_set(terms, *s)) ++s;
+  *out = s;
+  const char* b = p;  // strip surrounding whitespace like Python .strip()
+  const char* e = s;
+  while (b < e && (*b == ' ' || *b == '\t')) ++b;
+  while (e > b && (e[-1] == ' ' || e[-1] == '\t')) --e;
+  if (b == e) return 0.0;  // empty field
+  // hex floats ("0x10") parse via strtod but Python float() rejects them;
+  // treat as unknown tokens so both ingest paths agree
+  const char* h = b + (*b == '+' || *b == '-');
+  if (e - h > 1 && h[0] == '0' && (h[1] == 'x' || h[1] == 'X')) {
     *ok = false;
     return 0.0;
   }
-  if (v != v) v = 0.0;          // "nan" via strtod -> 0 like the reference
-  if (v > 1e308) v = 1e308;     // "inf" -> +-1e308 (common.h:284)
-  if (v < -1e308) v = -1e308;
-  *out = q;
-  return v;
+  char* q = nullptr;
+  double v = c_loc ? strtod_l(b, &q, c_loc) : std::strtod(b, &q);
+  if (q == e) {  // fully numeric (partial consumption falls through)
+    if (v != v) v = 0.0;       // "nan" via strtod -> 0 like the reference
+    if (v > 1e308) v = 1e308;  // "inf" -> +-1e308 (common.h:284)
+    if (v < -1e308) v = -1e308;
+    return v;
+  }
+  size_t n = static_cast<size_t>(e - b);
+  char t[5] = {0, 0, 0, 0, 0};
+  for (size_t i = 0; i < n && i < 4; ++i) t[i] = std::tolower(b[i]);
+  if ((n == 2 && !std::strcmp(t, "na")) || (n == 3 && !std::strcmp(t, "nan")) ||
+      (n == 4 && !std::strcmp(t, "null")))
+    return 0.0;
+  *ok = false;
+  return 0.0;
 }
 
 }  // namespace
@@ -101,8 +119,9 @@ int64_t lgt_parse_dense(const char* buf, int64_t len, char sep, double* out,
     if (line_end == p) continue;
     double* row = out + r * cols;
     int64_t c = 0;
+    const char terms[2] = {sep, 0};
     while (p < line_end && c < cols) {
-      row[c++] = parse_value(p, line_end, &p, &ok);
+      row[c++] = parse_value(p, line_end, terms, &p, &ok);
       if (!ok) return -(r + 1);
       while (p < line_end && *p != sep) ++p;  // skip to separator
       if (p < line_end) ++p;                  // past separator
@@ -158,7 +177,7 @@ int64_t lgt_parse_libsvm(const char* buf, int64_t len, double* label_out,
     const char* line_end = p;
     while (line_end < end && !is_eol(*line_end)) ++line_end;
     if (line_end == p) continue;
-    label_out[r] = parse_value(p, line_end, &p, &ok);
+    label_out[r] = parse_value(p, line_end, " \t", &p, &ok);
     if (!ok) return -(r + 1);
     double* row = feats_out + r * ncols;
     while (p < line_end) {
@@ -171,7 +190,7 @@ int64_t lgt_parse_libsvm(const char* buf, int64_t len, double* label_out,
         continue;
       }
       p = q + 1;  // past ':'
-      double v = parse_value(p, line_end, &p, &ok);
+      double v = parse_value(p, line_end, " \t:", &p, &ok);
       if (!ok) return -(r + 1);
       if (idx >= 0 && idx < ncols) row[idx] = v;
     }

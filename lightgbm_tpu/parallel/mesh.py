@@ -78,13 +78,14 @@ class ShardedGrower:
     def __init__(self, mesh: Mesh, *, max_leaves: int, max_bin: int,
                  params: SplitParams, max_depth: int = -1,
                  row_chunk: int = 0, voting_top_k: int = 0,
-                 hist_impl: str = "xla"):
+                 hist_impl: str = "xla", hist_agg: str = "psum"):
         self.mesh = mesh
         self.num_shards = mesh.devices.size
         kw = dict(max_leaves=max_leaves, max_bin=max_bin, params=params,
                   max_depth=max_depth, row_chunk=row_chunk,
                   psum_axis=DATA_AXIS, voting_top_k=voting_top_k,
-                  hist_impl=hist_impl)
+                  hist_impl=hist_impl, hist_agg=hist_agg,
+                  num_shards=self.num_shards)
         self._grow = _sharded_grow_fn(
             mesh, kw,
             in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
@@ -139,14 +140,11 @@ class FeatureShardedGrower:
         kw = dict(max_leaves=max_leaves, max_bin=max_bin, params=params,
                   max_depth=max_depth, row_chunk=row_chunk,
                   feature_axis=FEATURE_AXIS, hist_impl=hist_impl)
-        fn = functools.partial(grow_tree, **kw)
-        tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
-        self._grow = jax.jit(jax.shard_map(
-            fn, mesh=mesh,
+        self._grow = _sharded_grow_fn(
+            mesh, kw,
             in_specs=(P(FEATURE_AXIS, None), P(None), P(None),
                       P(None), P(FEATURE_AXIS)),
-            out_specs=(tree_specs, P(None)),
-            check_vma=False))
+            leaf_id_spec=P(None))
 
     def padded_features(self, f: int) -> int:
         return padded_size(f, self.num_shards)

@@ -49,7 +49,7 @@ def test_native_dense_token_edge_cases():
     label, feats = nat
     np.testing.assert_array_equal(label, [1.5, 0.0, 0.0, 2.0])
     np.testing.assert_array_equal(
-        feats, [[0.0, 3.0], [2.25, -np.inf], [0.0, 1e3], [0.0, 7.0]])
+        feats, [[0.0, 3.0], [2.25, -1e308], [0.0, 1e3], [0.0, 7.0]])
 
 
 def test_native_dense_short_rows():
@@ -89,3 +89,33 @@ def test_env_kill_switch(monkeypatch):
     monkeypatch.setattr(native, "_lib", None)
     assert native.get_lib() is None
     monkeypatch.setattr(native, "_tried", False)  # restore for later tests
+
+
+def test_native_rejects_numeric_prefixed_garbage():
+    """'2.5abc' must be a fatal parse error, matching _clean_token."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        pyparser._native_parse(["1,2.5abc,3"], 0, "csv")
+    with pytest.raises(LightGBMError):
+        pyparser.parse_dense(["1,2.5abc,3"], ",", 0)  # python fallback too
+
+
+def test_python_fallback_short_rows_zero_filled():
+    label, feats = pyparser.parse_dense(["1,na,3", "4,5"], ",", 0)
+    np.testing.assert_array_equal(label, [1.0, 4.0])
+    np.testing.assert_array_equal(feats, [[0.0, 3.0], [5.0, 0.0]])
+
+
+def test_header_skips_leading_blank_lines(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import load_dataset
+    rng = np.random.RandomState(0)
+    body = "\n".join("%d,%f,%f" % (i % 2, rng.randn(), rng.randn())
+                     for i in range(50))
+    f = tmp_path / "h.csv"
+    f.write_text("\nlabel,f0,f1\n" + body + "\n")
+    cfg = Config.from_params({"header": "true", "label_column": "name:label",
+                              "is_save_binary_file": "false"})
+    ds = load_dataset(str(f), cfg)
+    assert ds.num_data == 50
+    assert ds.feature_names == ["label", "f0", "f1"]

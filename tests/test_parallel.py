@@ -32,8 +32,9 @@ def test_eight_devices_available():
     assert len(jax.devices()) >= 8
 
 
+@pytest.mark.parametrize("hist_agg", ["psum", "scatter"])
 @pytest.mark.parametrize("n", [1000, 1003])  # non-divisible N exercises padding
-def test_sharded_tree_identical_to_serial(n):
+def test_sharded_tree_identical_to_serial(n, hist_agg):
     bins_t, grad, hess = make_data(n=n)
     f = bins_t.shape[0]
     serial_tree, serial_leaf = grow_tree(
@@ -42,7 +43,8 @@ def test_sharded_tree_identical_to_serial(n):
         max_leaves=15, max_bin=32, params=PARAMS)
 
     mesh = make_mesh(8)
-    grower = ShardedGrower(mesh, max_leaves=15, max_bin=32, params=PARAMS)
+    grower = ShardedGrower(mesh, max_leaves=15, max_bin=32, params=PARAMS,
+                           hist_agg=hist_agg)
     n_pad = padded_size(n, 8)
     bins_dev = grower.shard_bins(bins_t)
     pad = n_pad - n
