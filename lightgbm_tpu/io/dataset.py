@@ -151,7 +151,9 @@ def load_dataset(filename: str, config: Config,
         off = 0
         while off < len(raw) and not first:
             nxt_n = raw.find(b"\n", off)
-            nxt_r = raw.find(b"\r", off)
+            # bound the \r search to the current line so a CR-less file
+            # doesn't trigger a whole-buffer scan per header probe
+            nxt_r = raw.find(b"\r", off, nxt_n if nxt_n >= 0 else len(raw))
             ends = [e for e in (nxt_n, nxt_r) if e >= 0]
             eol = min(ends) if ends else len(raw)
             first = raw[off:eol].decode("utf-8", "replace").strip()

@@ -18,9 +18,10 @@ from typing import List, Optional
 
 import numpy as np
 
+from . import native
 from .config import Config
 from .io.dataset import Metadata
-from .objectives import default_label_gain, max_dcg_at_k
+from .objectives import check_rank_label, default_label_gain, max_dcg_at_k
 from .utils import log
 
 K_EPSILON = 1e-15
@@ -208,6 +209,7 @@ class NDCGMetric(Metric):
         if metadata.query_boundaries is None:
             log.fatal("The NDCG metric requires query information")
         self.qb = metadata.query_boundaries
+        check_rank_label(metadata.label, len(self.label_gain))
         self.names = ["%s's : NDCG@%d " % (test_name, k) for k in self.eval_at]
         nq = len(self.qb) - 1
         # cache inverse max DCG per (query, k)
@@ -222,6 +224,14 @@ class NDCGMetric(Metric):
         self.sum_query_weights = (float(nq) if qw is None else float(qw.sum()))
 
     def eval(self, score):
+        # Native path: per-query top-k membership under tied scores follows
+        # std::sort's permutation and fp32 accumulation (rank_metric.hpp:89-
+        # 145) — required for golden-log digit parity; see native/.
+        res = native.ndcg_eval(np.asarray(score, dtype=np.float32),
+                               self.metadata.label, self.qb, self.eval_at,
+                               self.label_gain, self.query_weights)
+        if res is not None:
+            return list(res / self.sum_query_weights)
         s = score.astype(np.float64)
         nq = len(self.qb) - 1
         result = np.zeros(len(self.eval_at))
@@ -232,9 +242,11 @@ class NDCGMetric(Metric):
             order = np.argsort(-s[a:b], kind="stable")
             gains = self.label_gain[lab[order]]
             for j, k in enumerate(self.eval_at):
-                if self.inv_max[q, j] <= 0:
-                    # all-negative query counts as perfect (rank_metric.hpp:99)
-                    result[j] += w
+                if self.inv_max[q, 0] <= 0:
+                    # all-negative query counts as perfect, UNWEIGHTED even
+                    # under query weights — reference quirk reproduced by
+                    # the native path too (rank_metric.hpp:99,120-123)
+                    result[j] += 1.0
                 else:
                     kk = min(k, b - a)
                     dcg = float((gains[:kk] * self.discount[:kk]).sum())

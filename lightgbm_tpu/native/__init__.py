@@ -97,6 +97,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_parse_libsvm.restype = i64
     lib.lgt_bin_values.argtypes = [pd, i64, pd, ctypes.c_int32, pu8]
     lib.lgt_bin_values.restype = None
+    pf = ctypes.POINTER(ctypes.c_float)
+    pi32 = ctypes.POINTER(ctypes.c_int32)
+    lib.lgt_lambdarank_grads.argtypes = [
+        pf, pf, pi32, i64, pf, pf, pf, pf, i64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, pf, pf, pf]
+    lib.lgt_lambdarank_grads.restype = None
+    lib.lgt_ndcg_eval.argtypes = [pf, pf, pi32, i64, pi32, i64, pf, i64,
+                                  pf, pd]
+    lib.lgt_ndcg_eval.restype = None
     _lib = lib
     return _lib
 
@@ -147,6 +156,69 @@ def parse_libsvm(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
             log.fatal("Unknown token in data file at row %d" % (-got - 1))
         label, feats = label[:got], feats[:got]
     return label, feats
+
+
+def lambdarank_grads(score, label, query_boundaries, inv_max_dcg, label_gain,
+                     discount, sigmoid_table, min_input, max_input,
+                     idx_factor, weights, n_out
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Reference-order lambdarank gradients (rank_objective.hpp:76-164);
+    None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    def f32(a):
+        return np.ascontiguousarray(a, dtype=np.float32)
+
+    def fp(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    score = f32(score)
+    label = f32(label)
+    qb = np.ascontiguousarray(query_boundaries, dtype=np.int32)
+    inv = f32(inv_max_dcg)
+    gain = f32(label_gain)
+    disc = f32(discount)
+    table = f32(sigmoid_table)
+    w = f32(weights) if weights is not None else None
+    lambdas = np.zeros(n_out, dtype=np.float32)
+    hessians = np.zeros(n_out, dtype=np.float32)
+    lib.lgt_lambdarank_grads(
+        fp(score), fp(label),
+        qb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(qb) - 1,
+        fp(inv), fp(gain), fp(disc), fp(table), len(table),
+        np.float32(min_input), np.float32(max_input), np.float32(idx_factor),
+        fp(w) if w is not None else None, fp(lambdas), fp(hessians))
+    return lambdas, hessians
+
+
+def ndcg_eval(score, label, query_boundaries, ks, label_gain, query_weights
+              ) -> Optional[np.ndarray]:
+    """Sum of per-query NDCG@ks in reference fp32/sort order, or None.
+    Caller divides by the query-weight sum."""
+    lib = get_lib()
+    if lib is None:
+        return None
+
+    def fp(a):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    score = np.ascontiguousarray(score, dtype=np.float32)
+    label = np.ascontiguousarray(label, dtype=np.float32)
+    qb = np.ascontiguousarray(query_boundaries, dtype=np.int32)
+    ks = np.ascontiguousarray(ks, dtype=np.int32)
+    gain = np.ascontiguousarray(label_gain, dtype=np.float32)
+    w = (np.ascontiguousarray(query_weights, dtype=np.float32)
+         if query_weights is not None else None)
+    out = np.zeros(len(ks), dtype=np.float64)
+    lib.lgt_ndcg_eval(fp(score), fp(label),
+                      qb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                      len(qb) - 1,
+                      ks.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                      len(ks), fp(gain), len(gain),
+                      fp(w) if w is not None else None, _dbl_ptr(out))
+    return out
 
 
 def bin_values(vals: np.ndarray, bounds: np.ndarray

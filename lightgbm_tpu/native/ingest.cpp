@@ -16,11 +16,15 @@
 //   g++ -O3 -shared -fPIC -std=c++17 ingest.cpp -o _ingest.so
 // and loaded through ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <cctype>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <locale.h>
+#include <vector>
 
 namespace {
 
@@ -198,6 +202,158 @@ int64_t lgt_parse_libsvm(const char* buf, int64_t len, double* label_out,
     ++r;
   }
   return r;
+}
+
+// Lambdarank gradients (the one objective whose reference semantics are
+// not order-free: reference src/objective/rank_objective.hpp:76-164).
+// Two properties force a native path for bit-parity with golden models:
+//   1. docs are ranked with non-stable std::sort, so the tie permutation
+//      (all scores equal at iteration 1!) is the libstdc++ introsort one;
+//   2. per-pair fp32 lambdas are accumulated sequentially in sorted order.
+// The Python fallback (objectives.py LambdarankNDCG._one_query) computes
+// the same math vectorized and is kept for no-toolchain environments.
+//
+// score/label are per-query slices laid out [N]; qb is [num_queries+1]
+// boundaries; sigmoid_table is the precomputed LUT with (min_input,
+// idx_factor) addressing, matching GetSigmoid (rank_objective.hpp:166-175).
+void lgt_lambdarank_grads(const float* score, const float* label,
+                          const int32_t* qb, int64_t num_queries,
+                          const float* inv_max_dcg, const float* label_gain,
+                          const float* discount, const float* sigmoid_table,
+                          int64_t sigmoid_bins, float min_input,
+                          float max_input, float idx_factor,
+                          const float* weights, float* lambdas,
+                          float* hessians) {
+  const float kMinScore = -std::numeric_limits<float>::infinity();
+  auto sig = [&](float s) -> float {
+    if (s <= min_input) return sigmoid_table[0];
+    if (s >= max_input) return sigmoid_table[sigmoid_bins - 1];
+    return sigmoid_table[static_cast<size_t>((s - min_input) * idx_factor)];
+  };
+  for (int64_t q = 0; q < num_queries; ++q) {
+    const int32_t start = qb[q];
+    const int32_t cnt = qb[q + 1] - start;
+    const float inv_mdcg = inv_max_dcg[q];
+    const float* sc = score + start;
+    const float* lb = label + start;
+    float* lam = lambdas + start;
+    float* hes = hessians + start;
+    for (int32_t i = 0; i < cnt; ++i) lam[i] = hes[i] = 0.0f;
+    std::vector<int32_t> order(cnt);
+    for (int32_t i = 0; i < cnt; ++i) order[i] = i;
+    std::sort(order.begin(), order.end(),
+              [sc](int32_t a, int32_t b) { return sc[a] > sc[b]; });
+    if (cnt == 0) continue;
+    const float best = sc[order[0]];
+    int32_t worst_pos = cnt - 1;
+    if (worst_pos > 0 && sc[order[worst_pos]] == kMinScore) --worst_pos;
+    const float worst = sc[order[worst_pos]];
+    for (int32_t i = 0; i < cnt; ++i) {
+      const int32_t hi = order[i];
+      if (sc[hi] == kMinScore) continue;
+      const int hi_lab = static_cast<int>(lb[hi]);
+      const float hi_gain = label_gain[hi_lab];
+      const float hi_disc = discount[i];
+      float sum_lam = 0.0f, sum_hes = 0.0f;
+      for (int32_t j = 0; j < cnt; ++j) {
+        if (i == j) continue;
+        const int32_t lo = order[j];
+        const int lo_lab = static_cast<int>(lb[lo]);
+        if (hi_lab <= lo_lab || sc[lo] == kMinScore) continue;
+        const float ds = sc[hi] - sc[lo];
+        float delta = (hi_gain - label_gain[lo_lab]) *
+                      std::fabs(hi_disc - discount[j]) * inv_mdcg;
+        if (hi_lab != lo_lab && best != worst)
+          delta /= (0.01f + std::fabs(ds));
+        float pl = sig(ds);
+        float ph = pl * (2.0f - pl);
+        pl *= -delta;
+        ph *= 2 * delta;
+        sum_lam += pl;
+        sum_hes += ph;
+        lam[lo] -= pl;
+        hes[lo] += ph;
+      }
+      lam[hi] += sum_lam;
+      hes[hi] += sum_hes;
+    }
+    if (weights) {
+      for (int32_t i = 0; i < cnt; ++i) {
+        lam[i] *= weights[start + i];
+        hes[i] *= weights[start + i];
+      }
+    }
+  }
+}
+
+// NDCG@ks over all queries (reference src/metric/rank_metric.hpp:89-145 +
+// src/metric/dcg_calculator.cpp).  Native for the same reason as the
+// lambdarank gradients: the top-k membership under tied scores follows
+// std::sort's permutation, and DCG / inverse-max-DCG accumulate in fp32.
+// out[j] = sum over queries of NDCG@ks[j] (caller divides by the weight
+// sum).  All-negative queries contribute 1.0 regardless of weight — a
+// reference quirk (rank_metric.hpp:120-123) reproduced on purpose.
+void lgt_ndcg_eval(const float* score, const float* label, const int32_t* qb,
+                   int64_t num_queries, const int32_t* ks, int64_t num_k,
+                   const float* label_gain, int64_t num_gain,
+                   const float* query_weights, double* out) {
+  std::vector<float> discount;
+  {
+    int32_t max_cnt = 1;
+    for (int64_t q = 0; q < num_queries; ++q)
+      max_cnt = std::max(max_cnt, qb[q + 1] - qb[q]);
+    discount.resize(max_cnt);
+    for (int32_t i = 0; i < max_cnt; ++i)
+      discount[i] = 1.0f / std::log2(2.0f + i);
+  }
+  for (int64_t j = 0; j < num_k; ++j) out[j] = 0.0;
+  std::vector<int32_t> label_cnt(num_gain);
+  std::vector<float> inv(num_k), dcgs(num_k);
+  std::vector<int32_t> order;
+  for (int64_t q = 0; q < num_queries; ++q) {
+    const int32_t start = qb[q];
+    const int32_t cnt = qb[q + 1] - start;
+    const float* lb = label + start;
+    const float* sc = score + start;
+    // inverse max DCG at each k, one pass (dcg_calculator.cpp:58-88)
+    std::fill(label_cnt.begin(), label_cnt.end(), 0);
+    for (int32_t i = 0; i < cnt; ++i) ++label_cnt[static_cast<int>(lb[i])];
+    float cur = 0.0f;
+    int32_t left = 0;
+    int top = static_cast<int>(num_gain) - 1;
+    for (int64_t j = 0; j < num_k; ++j) {
+      int32_t k = std::min(ks[j], cnt);
+      for (int32_t p = left; p < k; ++p) {
+        while (top > 0 && label_cnt[top] <= 0) --top;
+        if (top < 0) break;
+        cur += discount[p] * label_gain[top];
+        --label_cnt[top];
+      }
+      inv[j] = cur > 0.0f ? 1.0f / cur : -1.0f;
+      left = k;
+    }
+    if (inv[0] <= 0.0f) {
+      for (int64_t j = 0; j < num_k; ++j) out[j] += 1.0;
+      continue;
+    }
+    // DCG at each k over the std::sort order (dcg_calculator.cpp:112-136)
+    order.resize(cnt);
+    for (int32_t i = 0; i < cnt; ++i) order[i] = i;
+    std::sort(order.begin(), order.end(),
+              [sc](int32_t a, int32_t b) { return sc[a] > sc[b]; });
+    cur = 0.0f;
+    left = 0;
+    for (int64_t j = 0; j < num_k; ++j) {
+      int32_t k = std::min(ks[j], cnt);
+      for (int32_t p = left; p < k; ++p)
+        cur += label_gain[static_cast<int>(lb[order[p]])] * discount[p];
+      dcgs[j] = cur;
+      left = k;
+    }
+    const float w = query_weights ? query_weights[q] : 1.0f;
+    for (int64_t j = 0; j < num_k; ++j)
+      out[j] += static_cast<double>(dcgs[j] * inv[j] * w);
+  }
 }
 
 // value -> bin: upper-bound binary search over bin_upper_bound, exactly

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import native
 from .config import Config
 from .io.dataset import Metadata
 from .utils import log
@@ -209,6 +210,7 @@ class LambdarankNDCG(Objective):
             log.fatal("Lambdarank tasks require query information")
         self.qb = metadata.query_boundaries
         label = metadata.label
+        check_rank_label(label, len(self.label_gain))
         nq = len(self.qb) - 1
         inv = np.zeros(nq, dtype=np.float32)
         for q in range(nq):
@@ -229,6 +231,16 @@ class LambdarankNDCG(Objective):
 
     def get_gradients(self, score):
         score_np = np.asarray(score, dtype=np.float32)
+        # Reference-order native path: bit-parity with the golden models
+        # needs libstdc++ std::sort tie permutations and sequential fp32
+        # pair accumulation (rank_objective.hpp:76-164) — see native/.
+        res = native.lambdarank_grads(
+            score_np[:self.num_data], self.metadata.label, self.qb,
+            self.inverse_max_dcgs, self.label_gain, self.discount,
+            self.sigmoid_table, self.min_in, self.max_in, self.idx_factor,
+            self.weights, self.n_pad)
+        if res is not None:
+            return jnp.asarray(res[0]), jnp.asarray(res[1])
         # padded rows (beyond the last query boundary) stay zero
         lambdas = np.zeros(self.n_pad, dtype=np.float32)
         hessians = np.zeros(self.n_pad, dtype=np.float32)
@@ -287,6 +299,16 @@ class LambdarankNDCG(Objective):
 def default_label_gain():
     # 2^i - 1 (reference src/io/config.cpp:221-227)
     return [0.0] + [float((1 << i) - 1) for i in range(1, 31)]
+
+
+def check_rank_label(label: np.ndarray, num_gains: int) -> None:
+    """Labels must index label_gain (reference dcg_calculator.cpp:65's
+    Log::Fatal, checked up front here because the native kernels index
+    label_cnt/label_gain without bounds checks)."""
+    lab = np.asarray(label)
+    if len(lab) and (lab.min() < 0 or lab.max() >= num_gains):
+        log.fatal("Ranking label out of range of label_gain: %g"
+                  % (lab.min() if lab.min() < 0 else lab.max()))
 
 
 def max_dcg_at_k(k: int, label: np.ndarray, label_gain: np.ndarray,

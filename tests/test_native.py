@@ -119,3 +119,120 @@ def test_header_skips_leading_blank_lines(tmp_path):
     ds = load_dataset(str(f), cfg)
     assert ds.num_data == 50
     assert ds.feature_names == ["label", "f0", "f1"]
+
+
+def test_native_lambdarank_matches_python_fallback():
+    """Native reference-order gradients vs the vectorized Python path:
+    same math, so agreement to fp32 tolerance on untied scores (ties are
+    exactly where they legitimately differ)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.objectives import LambdarankNDCG
+
+    rng = np.random.RandomState(0)
+    n, nq = 200, 10
+    qb = np.sort(rng.choice(np.arange(1, n), nq - 1, replace=False))
+    qb = np.concatenate([[0], qb, [n]]).astype(np.int32)
+    label = rng.randint(0, 4, size=n).astype(np.float32)
+    score = rng.randn(n).astype(np.float32)  # untied with prob 1
+
+    cfg = Config.from_params({"objective": "lambdarank"})
+    obj = LambdarankNDCG(cfg)
+    obj.init(Metadata(label=label, query_boundaries=qb), n)
+    obj.pad_to(n)
+
+    lam_n, hes_n = (np.asarray(a) for a in obj.get_gradients(score))
+    os.environ["LGBM_TPU_NO_NATIVE"] = "1"
+    try:
+        # reset the module cache so the kill switch takes effect
+        native._lib, native._tried = None, False
+        assert native.lambdarank_grads(
+            score, label, qb, obj.inverse_max_dcgs, obj.label_gain,
+            obj.discount, obj.sigmoid_table, obj.min_in, obj.max_in,
+            obj.idx_factor, None, n) is None
+        lam_p, hes_p = (np.asarray(a) for a in obj.get_gradients(score))
+    finally:
+        del os.environ["LGBM_TPU_NO_NATIVE"]
+        native._lib, native._tried = None, False
+    np.testing.assert_allclose(lam_n, lam_p, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(hes_n, hes_p, rtol=2e-5, atol=1e-7)
+
+
+def test_native_ndcg_matches_python_fallback():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.metrics import NDCGMetric
+
+    rng = np.random.RandomState(1)
+    n, nq = 300, 12
+    qb = np.sort(rng.choice(np.arange(1, n), nq - 1, replace=False))
+    qb = np.concatenate([[0], qb, [n]]).astype(np.int32)
+    label = rng.randint(0, 4, size=n).astype(np.float32)
+    score = rng.randn(n)
+
+    cfg = Config.from_params({"metric": "ndcg", "ndcg_eval_at": "1,3,5"})
+    m = NDCGMetric(cfg)
+    md = Metadata(label=label, query_boundaries=qb)
+    md.finish_queries()
+    m.init("t", md, n)
+    vals_native = m.eval(score)
+    os.environ["LGBM_TPU_NO_NATIVE"] = "1"
+    try:
+        native._lib, native._tried = None, False
+        vals_py = m.eval(score)
+    finally:
+        del os.environ["LGBM_TPU_NO_NATIVE"]
+        native._lib, native._tried = None, False
+    np.testing.assert_allclose(vals_native, vals_py, rtol=1e-5)
+
+
+def test_rank_label_out_of_range_is_fatal():
+    """Negative / oversized ranking labels must fail fast in Python before
+    reaching the native kernels (which index label_gain unchecked)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.metrics import NDCGMetric
+    from lightgbm_tpu.objectives import LambdarankNDCG
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    qb = np.array([0, 3], dtype=np.int32)
+    for bad in (np.array([-1.0, 0, 1]), np.array([0.0, 1, 99])):
+        md = Metadata(label=bad.astype(np.float32), query_boundaries=qb)
+        md.finish_queries()
+        obj = LambdarankNDCG(Config.from_params({"objective": "lambdarank"}))
+        with pytest.raises(LightGBMError):
+            obj.init(md, 3)
+        m = NDCGMetric(Config.from_params({"metric": "ndcg"}))
+        with pytest.raises(LightGBMError):
+            m.init("t", md, 3)
+
+
+def test_ndcg_all_negative_query_unweighted_quirk():
+    """All-negative queries add 1.0 regardless of query weight in BOTH the
+    native and Python paths (rank_metric.hpp:120-123 quirk)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.metrics import NDCGMetric
+
+    qb = np.array([0, 2, 4], dtype=np.int32)
+    label = np.array([0, 0, 2, 1], dtype=np.float32)  # query 0 all-negative
+    weights = np.array([3.0, 1.0, 1.0, 1.0], dtype=np.float32)
+    score = np.array([0.5, 0.1, 0.9, 0.2])
+    md = Metadata(label=label, query_boundaries=qb, weights=weights)
+    md.finish_queries()
+    m = NDCGMetric(Config.from_params({"metric": "ndcg",
+                                       "ndcg_eval_at": "2"}))
+    m.init("t", md, 4)
+    got_native = m.eval(score)
+    os.environ["LGBM_TPU_NO_NATIVE"] = "1"
+    try:
+        native._lib, native._tried = None, False
+        got_py = m.eval(score)
+    finally:
+        del os.environ["LGBM_TPU_NO_NATIVE"]
+        native._lib, native._tried = None, False
+    np.testing.assert_allclose(got_native, got_py, rtol=1e-6)
+    # query weights are per-query means of row weights -> [2, 1], sum 3.
+    # query 0 (all-negative) contributes 1.0 (NOT its weight 2); query 1 is
+    # perfectly ranked -> weighted 1*1.0.  (1.0 + 1.0) / 3.
+    assert abs(got_native[0] - 2.0 / 3.0) < 1e-6
