@@ -212,13 +212,7 @@ class NDCGMetric(Metric):
         check_rank_label(metadata.label, len(self.label_gain))
         self.names = ["%s's : NDCG@%d " % (test_name, k) for k in self.eval_at]
         nq = len(self.qb) - 1
-        # cache inverse max DCG per (query, k)
-        self.inv_max = np.zeros((nq, len(self.eval_at)))
-        for q in range(nq):
-            lab = metadata.label[self.qb[q]:self.qb[q + 1]]
-            for j, k in enumerate(self.eval_at):
-                m = max_dcg_at_k(k, lab, self.label_gain, self.discount)
-                self.inv_max[q, j] = 1.0 / m if m > 0 else -1.0
+        self._inv_max = None   # per-(query, k) cache, fallback path only
         qw = metadata.query_weights
         self.query_weights = qw
         self.sum_query_weights = (float(nq) if qw is None else float(qw.sum()))
@@ -232,8 +226,18 @@ class NDCGMetric(Metric):
                                self.label_gain, self.query_weights)
         if res is not None:
             return list(res / self.sum_query_weights)
-        s = score.astype(np.float64)
+        s = np.asarray(score).astype(np.float64)
         nq = len(self.qb) - 1
+        if self._inv_max is None:
+            # built only here: the native path recomputes it in C++ and
+            # this python double loop is expensive at many-query scale
+            self._inv_max = np.zeros((nq, len(self.eval_at)))
+            for q in range(nq):
+                lab = self.metadata.label[self.qb[q]:self.qb[q + 1]]
+                for j, k in enumerate(self.eval_at):
+                    m = max_dcg_at_k(k, lab, self.label_gain, self.discount)
+                    self._inv_max[q, j] = 1.0 / m if m > 0 else -1.0
+        inv_max = self._inv_max
         result = np.zeros(len(self.eval_at))
         for q in range(nq):
             a, b = int(self.qb[q]), int(self.qb[q + 1])
@@ -242,7 +246,7 @@ class NDCGMetric(Metric):
             order = np.argsort(-s[a:b], kind="stable")
             gains = self.label_gain[lab[order]]
             for j, k in enumerate(self.eval_at):
-                if self.inv_max[q, 0] <= 0:
+                if inv_max[q, 0] <= 0:
                     # all-negative query counts as perfect, UNWEIGHTED even
                     # under query weights — reference quirk reproduced by
                     # the native path too (rank_metric.hpp:99,120-123)
@@ -250,7 +254,7 @@ class NDCGMetric(Metric):
                 else:
                     kk = min(k, b - a)
                     dcg = float((gains[:kk] * self.discount[:kk]).sum())
-                    result[j] += dcg * self.inv_max[q, j] * w
+                    result[j] += dcg * inv_max[q, j] * w
         return list(result / self.sum_query_weights)
 
 

@@ -35,6 +35,33 @@ from .tree import Tree
 NO_LIMIT = -1
 
 
+class _PendingTree:
+    """A trained tree still packed in device buffers (async host copy in
+    flight); GBDT._flush_pending unpacks batches of these into host Trees
+    without blocking the per-iteration dispatch pipeline."""
+
+    __slots__ = ("ints", "floats", "lr")
+
+    def __init__(self, ints, floats, lr):
+        self.ints = ints
+        self.floats = floats
+        self.lr = lr
+
+
+@jax.jit
+def _pack_tree(dev_tree):
+    """TreeArrays -> (int32 buffer, float buffer): two flat arrays so a
+    whole tree ships device->host in two async copies instead of eleven."""
+    ints = jnp.concatenate([
+        dev_tree.num_leaves.reshape(1), dev_tree.split_feature,
+        dev_tree.threshold_bin, dev_tree.left_child, dev_tree.right_child,
+        dev_tree.leaf_parent, dev_tree.leaf_depth, dev_tree.leaf_count,
+    ]).astype(jnp.int32)
+    floats = jnp.concatenate([dev_tree.split_gain, dev_tree.leaf_value,
+                              dev_tree.internal_value])
+    return ints, floats
+
+
 class GBDT:
     name = "gbdt"
 
@@ -46,7 +73,23 @@ class GBDT:
         self.objective = objective
         self.num_class = config.num_class
         self.iter = 0
-        self.models: List[Tree] = []
+        self._models: List = []       # Tree | _PendingTree (see models prop)
+        self._stopped = False
+        # 1-leaf-stump stop detection is batched: fetching num_leaves every
+        # iteration costs a device->host roundtrip (tens of ms on remote-
+        # attached TPUs) that would serialize the async dispatch pipeline.
+        # Deferral is only sound when a stump implies every later tree is
+        # an identical zero-valued stump (so late truncation at the next
+        # flush reproduces the reference's stop point, gbdt.cpp:186, with
+        # no numerical difference): single-class, no bagging, no
+        # feature_fraction — under those, per-tree masks change and a real
+        # tree can follow a stump, so flush every iteration.  DART also
+        # sets 1 (dropping needs host trees each iteration).
+        deferrable = (config.num_class == 1
+                      and not (config.bagging_fraction < 1.0
+                               and config.bagging_freq > 0)
+                      and config.feature_fraction >= 1.0)
+        self._flush_every = 16 if deferrable else 1
         self.num_used_model = 0
         self.early_stopping_round = config.early_stopping_round
         self.shrinkage_rate = config.learning_rate
@@ -54,7 +97,7 @@ class GBDT:
         self.valid_data: List[Dataset] = []
         self.valid_metrics: List[List[Metric]] = []
         self.valid_bins_dev: List[jax.Array] = []
-        self.valid_scores: List[np.ndarray] = []
+        self.valid_scores: List[jax.Array] = []
         self.best_iter: List[List[int]] = []
         self.best_score: List[List[float]] = []
         self.saved_upto = -1
@@ -187,14 +230,12 @@ class GBDT:
         self.valid_bins_dev.append(jnp.asarray(data.bins))
         k = self.num_class
         vn = data.num_data
-        if data.metadata.init_score is not None:
+        if (data.metadata.init_score is not None
+                and np.asarray(data.metadata.init_score).size == vn * k):
             init = np.asarray(data.metadata.init_score, dtype=np.float32)
-            if init.size == vn * k:
-                self.valid_scores.append(init.reshape(k, vn).copy())
-            else:
-                self.valid_scores.append(np.zeros((k, vn), dtype=np.float32))
+            self.valid_scores.append(jnp.asarray(init.reshape(k, vn)))
         else:
-            self.valid_scores.append(np.zeros((k, vn), dtype=np.float32))
+            self.valid_scores.append(jnp.zeros((k, vn), dtype=jnp.float32))
         if self.early_stopping_round > 0:
             self.best_iter.append([0] * len(metrics))
             self.best_score.append([-np.inf] * len(metrics))
@@ -260,15 +301,15 @@ class GBDT:
         for cls in range(self.num_class):
             self._bagging(self.iter, cls)
             fmask = self._feature_mask(cls)
-            tree, stop = self._train_tree(grad[cls], hess[cls],
-                                          self._bag_mask_dev(cls), fmask, cls)
-            if stop:
+            self._models.append(self._train_tree(
+                grad[cls], hess[cls], self._bag_mask_dev(cls), fmask, cls))
+        self.iter += 1
+        self.num_used_model = len(self._models) // self.num_class
+        if is_eval or self.iter % self._flush_every == 0:
+            if self._flush_pending():
                 log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
                 return True
-            self.models.append(tree)
-        self.iter += 1
-        self.num_used_model = len(self.models) // self.num_class
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
@@ -297,9 +338,6 @@ class GBDT:
                 max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
                 params=self.params, max_depth=cfg.max_depth,
                 hist_impl=self.hist_impl)
-        num_leaves = int(dev_tree.num_leaves)
-        if num_leaves <= 1:
-            return None, True
 
         lr = self.shrinkage_rate
         # train-score update: leaf_value[leaf_id] gather for ALL rows —
@@ -307,45 +345,101 @@ class GBDT:
         # out-of-bag traversal (gbdt.cpp:162-167, score_updater.hpp:44-68).
         # Shrinkage multiplies in the hist dtype BEFORE the f32 cast, like
         # the reference's double leaf_value * rate then score_t cast.
+        # (A 1-leaf stump has leaf_value[0] == 0, so this add is a no-op
+        # for stopped trees — see _flush_pending.)
         leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
         self.scores = self.scores.at[cls].add(leaf_vals[leaf_id])
 
-        # validation scores via vectorized binned traversal
+        # validation scores via vectorized binned traversal, kept on device
         for i, vbins in enumerate(self.valid_bins_dev):
             vleaf = predict_leaf_binned(dev_tree.split_feature,
                                         dev_tree.threshold_bin,
                                         dev_tree.left_child,
                                         dev_tree.right_child, vbins)
-            self.valid_scores[i][cls] += np.asarray(leaf_vals)[np.asarray(vleaf)]
+            self.valid_scores[i] = (
+                self.valid_scores[i].at[cls].add(leaf_vals[vleaf]))
 
-        tree = self._to_host_tree(dev_tree, num_leaves)
-        tree.shrinkage(lr)
-        return tree, False
+        # Pack the tree into two flat buffers and start an async
+        # device->host copy: by the time the next flush unpacks it, the
+        # bytes are already on the host, so training never blocks on a
+        # per-iteration roundtrip.
+        ints, floats = _pack_tree(dev_tree)
+        for a in (ints, floats):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+        return _PendingTree(ints, floats, lr)
 
-    def _to_host_tree(self, dev_tree, num_leaves: int) -> Tree:
+    # -- lazy host materialization ------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host trees; materializes any pending device trees first."""
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._models = list(value)
+
+    def _flush_pending(self) -> bool:
+        """Unpack pending device trees; truncate at the first 1-leaf stump
+        (the reference stops training there, gbdt.cpp:186; every later
+        tree is an identical zero-valued stump, so dropping them is exact).
+        Returns True when training must stop."""
+        stop_at = None
+        for idx, m in enumerate(self._models):
+            if not isinstance(m, _PendingTree):
+                continue
+            tree = self._unpack_tree(m)
+            self._models[idx] = tree
+            if tree.num_leaves <= 1 and stop_at is None:
+                stop_at = idx
+        if stop_at is not None:
+            del self._models[stop_at:]
+            self._stopped = True
+            self.num_used_model = len(self._models) // self.num_class
+        return self._stopped
+
+    def _unpack_tree(self, p: "_PendingTree") -> Tree:
+        L = max(self.config.num_leaves, 2)
+        ints = np.asarray(p.ints)
+        floats = np.asarray(p.floats, dtype=np.float64)
+        nl = int(ints[0])
+        o = 1
+        sf, tb, lc, rc, lp, ld, lcnt = (
+            ints[o:o + L - 1], ints[o + L - 1:o + 2 * (L - 1)],
+            ints[o + 2 * (L - 1):o + 3 * (L - 1)],
+            ints[o + 3 * (L - 1):o + 4 * (L - 1)],
+            ints[o + 4 * (L - 1):o + 4 * (L - 1) + L],
+            ints[o + 4 * (L - 1) + L:o + 4 * (L - 1) + 2 * L],
+            ints[o + 4 * (L - 1) + 2 * L:o + 4 * (L - 1) + 3 * L])
+        sg = floats[:L - 1]
+        lv = floats[L - 1:2 * L - 1]
+        iv = floats[2 * L - 1:3 * L - 2]
         ds = self.train_data
-        nl = num_leaves
-        sf = np.asarray(dev_tree.split_feature)[:nl - 1]
-        tb = np.asarray(dev_tree.threshold_bin)[:nl - 1]
+        sf = sf[:nl - 1]
+        tb = tb[:nl - 1]
         bounds = [ds.bin_mappers[f].bin_upper_bound for f in sf]
         threshold = np.array([bounds[i][tb[i]] for i in range(nl - 1)],
                              dtype=np.float64)
-        return Tree(
+        tree = Tree(
             num_leaves=nl,
             split_feature=sf.copy(),
             split_feature_real=ds.real_feature_index[sf].astype(np.int32),
             threshold_bin=tb.copy(),
             threshold=threshold,
-            split_gain=np.asarray(dev_tree.split_gain, dtype=np.float64)[:nl - 1],
-            left_child=np.asarray(dev_tree.left_child)[:nl - 1],
-            right_child=np.asarray(dev_tree.right_child)[:nl - 1],
-            internal_value=np.asarray(dev_tree.internal_value,
-                                      dtype=np.float64)[:nl - 1],
-            leaf_parent=np.asarray(dev_tree.leaf_parent)[:nl],
-            leaf_value=np.asarray(dev_tree.leaf_value, dtype=np.float64)[:nl],
-            leaf_depth=np.asarray(dev_tree.leaf_depth)[:nl],
-            leaf_count=np.asarray(dev_tree.leaf_count)[:nl],
+            split_gain=sg[:nl - 1],
+            left_child=lc[:nl - 1],
+            right_child=rc[:nl - 1],
+            internal_value=iv[:nl - 1],
+            leaf_parent=lp[:nl],
+            leaf_value=lv[:nl],
+            leaf_depth=ld[:nl],
+            leaf_count=lcnt[:nl],
         )
+        tree.shrinkage(p.lr)
+        return tree
 
     def _training_score(self):
         s = self.scores[:, :self.num_data]
@@ -360,6 +454,14 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_and_check_early_stopping(self) -> bool:
+        # Flush BEFORE evaluating: if a pending 1-leaf stump stopped
+        # training, that stop wins — evaluating or popping trees off the
+        # truncated model would corrupt it (the reference never reaches
+        # its early-stopping path after the stump stop, gbdt.cpp:186).
+        if self._flush_pending():
+            log.info("Stopped training because there are no more leafs "
+                     "that meet the split requirements.")
+            return True
         stop = self.output_metric(self.iter)
         if stop:
             log.info("Early stopping at iteration %d, the best iteration "
@@ -381,7 +483,7 @@ class GBDT:
                     log.info("Iteration: %d, %s : %f" % (it, name, val))
         if it % cfg.metric_freq == 0 or self.early_stopping_round > 0:
             for i in range(len(self.valid_metrics)):
-                vs = self.valid_scores[i]
+                vs = np.asarray(self.valid_scores[i])
                 score = vs[0] if self.num_class == 1 else vs
                 for j, metric in enumerate(self.valid_metrics[i]):
                     vals = metric.eval(score)
@@ -402,7 +504,7 @@ class GBDT:
             score = np.asarray(self._training_score())
             return [v for m in self.training_metrics for v in m.eval(score)]
         i = data_idx - 1
-        vs = self.valid_scores[i]
+        vs = np.asarray(self.valid_scores[i])
         score = vs[0] if self.num_class == 1 else vs
         return [v for m in self.valid_metrics[i] for v in m.eval(score)]
 
@@ -544,6 +646,8 @@ class DART(GBDT):
         self.drop_rate = config.drop_rate
         self.drop_rng = Mt19937Random(config.drop_seed)
         self.drop_index: List[int] = []
+        # dropping needs host trees every iteration anyway
+        self._flush_every = 1
 
     def _score_for_gradients(self):
         self._dropping_trees()
@@ -575,8 +679,9 @@ class DART(GBDT):
                     jnp.asarray(tree.threshold_bin),
                     jnp.asarray(tree.left_child),
                     jnp.asarray(tree.right_child), vbins))
-                self.valid_scores[i][cls] += \
-                    (tree.leaf_value * scale).astype(np.float32)[leaf]
+                vv = (tree.leaf_value * scale).astype(np.float32)[leaf]
+                self.valid_scores[i] = (
+                    self.valid_scores[i].at[cls].add(jnp.asarray(vv)))
 
     def _dropping_trees(self) -> None:
         """dart.hpp:86-110: drop trees from the train score, set shrinkage."""

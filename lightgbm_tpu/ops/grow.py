@@ -1,4 +1,4 @@
-"""Leaf-wise tree growth as one jitted `lax.while_loop`.
+"""Leaf-wise tree growth as one jitted fixed-trip `lax.scan`.
 
 TPU-native redesign of SerialTreeLearner::Train
 (reference src/treelearner/serial_tree_learner.cpp:100-134):
@@ -11,7 +11,7 @@ TPU-native redesign of SerialTreeLearner::Train
     trick (FeatureHistogram::Subtract, feature_histogram.hpp:97-106) is a
     tensor subtract, halving histogram work exactly as in the reference.
   - The whole `num_leaves - 1` split loop runs on-device inside one
-    compiled while_loop; host sees a single call per tree.
+    compiled fixed-trip scan; host sees a single call per tree.
 
 Out-of-bag rows keep following splits via leaf_id (they are masked out of
 histograms by bag_mask); this makes the final score update a single
@@ -229,15 +229,16 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     hist_psum = (lambda x: x) if (voting or scatter) else psum
 
     if hist_impl == "pallas":
-        from .hist_pallas import leaf_histogram_masked, make_gh8
-        gh8 = make_gh8(grad, hess)
-        bag_i32 = bag_mask.astype(jnp.int32)
+        from .hist_pallas import (fold_leaf_mask, leaf_histogram_masked,
+                                  make_gh2)
+        gh2 = make_gh2(grad, hess)
         # TPU runs the compiled kernel; CPU (tests) uses interpret mode
         interpret = jax.default_backend() == "cpu"
 
         def hist_leaf(leaf_id, target):
+            leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
             return hist_psum(leaf_histogram_masked(
-                bins_t, gh8, leaf_id, bag_i32, target,
+                bins_t, gh2, leaf_eff, target,
                 max_bin=max_bin, interpret=interpret).astype(dtype))
     else:
         def hist_leaf(leaf_id, target):
@@ -281,7 +282,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         best=best,
     )
 
-    def cond(st: GrowState):
+    def active(st: GrowState):
         return ((st.tree.num_leaves < max_leaves)
                 & (jnp.max(st.best.gain) > 0.0))
 
@@ -358,5 +359,20 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                          leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
                          best=best)
 
-    final = jax.lax.while_loop(cond, body, state)
+    # Fixed-trip scan instead of lax.while_loop: a while_loop's per-
+    # iteration continuation check serializes against the body's full
+    # critical path and costs ~ms/step on remote-attached TPUs, ~8x the
+    # body itself.  The scan always runs max_leaves-1 steps; once growth
+    # stops (no positive gain / leaf budget reached) the body's result is
+    # discarded by a select, which preserves the reference's early-stop
+    # semantics (serial_tree_learner.cpp:121-129) at the cost of dead
+    # iterations only for trees that finish early.
+    def step(st: GrowState, _):
+        new_st = body(st)
+        keep = active(st)
+        st = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, a, b), new_st, st)
+        return st, None
+
+    final, _ = jax.lax.scan(step, state, None, length=max_leaves - 1)
     return final.tree, final.leaf_id

@@ -8,9 +8,9 @@ import pytest
 
 from lightgbm_tpu.ops.histogram import leaf_histogram, make_gvals
 from lightgbm_tpu.ops.hist_pallas import (PALLAS_ROW_BLOCK,
+                                          fold_leaf_mask,
                                           leaf_histogram_masked,
-                                          leaf_histogram_pallas, make_gh8,
-                                          make_gvals8)
+                                          leaf_histogram_pallas, make_gh2)
 
 
 def _data(n, f, b, seed=0):
@@ -26,9 +26,9 @@ def _data(n, f, b, seed=0):
 def test_pallas_matches_xla_oracle(f, b):
     n = 512  # small row_block keeps interpret mode fast
     bins_t, grad, hess, mask = _data(n, f, b)
-    gv8 = make_gvals8(jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask))
-    got = leaf_histogram_pallas(jnp.asarray(bins_t), gv8, max_bin=b,
-                                row_block=128, interpret=True)
+    gh2 = make_gh2(jnp.asarray(grad), jnp.asarray(hess))
+    got = leaf_histogram_pallas(jnp.asarray(bins_t), gh2, jnp.asarray(mask),
+                                max_bin=b, row_block=128, interpret=True)
     gv = make_gvals(jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask),
                     jnp.float32)
     want = leaf_histogram(jnp.asarray(bins_t), gv, max_bin=b)
@@ -43,9 +43,10 @@ def test_masked_kernel_matches_xla_oracle():
     leaf_id = rng.randint(0, 5, size=n).astype(np.int32)
     bag = (rng.rand(n) < 0.8).astype(np.int32)
     target = 3
-    gh8 = make_gh8(jnp.asarray(grad), jnp.asarray(hess))
+    gh2 = make_gh2(jnp.asarray(grad), jnp.asarray(hess))
+    leaf_eff = fold_leaf_mask(jnp.asarray(leaf_id), jnp.asarray(bag) != 0)
     got = leaf_histogram_masked(
-        jnp.asarray(bins_t), gh8, jnp.asarray(leaf_id), jnp.asarray(bag),
+        jnp.asarray(bins_t), gh2, leaf_eff,
         jnp.int32(target), max_bin=b, row_block=128, interpret=True)
     mask = (leaf_id == target) & (bag != 0)
     gv = make_gvals(jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(mask),
@@ -58,10 +59,10 @@ def test_masked_kernel_matches_xla_oracle():
 def test_masked_kernel_empty_leaf():
     n, f, b = 256, 4, 32
     bins_t, grad, hess, _ = _data(n, f, b, seed=5)
-    gh8 = make_gh8(jnp.asarray(grad), jnp.asarray(hess))
+    gh2 = make_gh2(jnp.asarray(grad), jnp.asarray(hess))
     got = leaf_histogram_masked(
-        jnp.asarray(bins_t), gh8, jnp.zeros(n, jnp.int32),
-        jnp.ones(n, jnp.int32), jnp.int32(7),  # no row has leaf 7
+        jnp.asarray(bins_t), gh2, jnp.zeros(n, jnp.int32),
+        jnp.int32(7),  # no row has leaf 7
         max_bin=b, row_block=128, interpret=True)
     assert float(jnp.abs(got).max()) == 0.0
 
