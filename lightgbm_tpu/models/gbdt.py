@@ -75,21 +75,7 @@ class GBDT:
         self.iter = 0
         self._models: List = []       # Tree | _PendingTree (see models prop)
         self._stopped = False
-        # 1-leaf-stump stop detection is batched: fetching num_leaves every
-        # iteration costs a device->host roundtrip (tens of ms on remote-
-        # attached TPUs) that would serialize the async dispatch pipeline.
-        # Deferral is only sound when a stump implies every later tree is
-        # an identical zero-valued stump (so late truncation at the next
-        # flush reproduces the reference's stop point, gbdt.cpp:186, with
-        # no numerical difference): single-class, no bagging, no
-        # feature_fraction — under those, per-tree masks change and a real
-        # tree can follow a stump, so flush every iteration.  DART also
-        # sets 1 (dropping needs host trees each iteration).
-        deferrable = (config.num_class == 1
-                      and not (config.bagging_fraction < 1.0
-                               and config.bagging_freq > 0)
-                      and config.feature_fraction >= 1.0)
-        self._flush_every = 16 if deferrable else 1
+        self._flush_every = 1   # recomputed below once bagging state is known
         self.num_used_model = 0
         self.early_stopping_round = config.early_stopping_round
         self.shrinkage_rate = config.learning_rate
@@ -199,6 +185,21 @@ class GBDT:
         # bagging state (gbdt.cpp:70-79); padded rows stay False forever
         self.bagging_enabled = (config.bagging_fraction < 1.0
                                 and config.bagging_freq > 0)
+        # 1-leaf-stump stop detection is batched: fetching num_leaves every
+        # iteration costs a device->host roundtrip (tens of ms on remote-
+        # attached TPUs) that would serialize the async dispatch pipeline.
+        # Deferral is only sound when a stump implies every later tree is
+        # an identical zero-valued stump (so late truncation at the next
+        # flush reproduces the reference's stop point, gbdt.cpp:186, with
+        # no numerical difference): single-class, no bagging, no
+        # feature_fraction — under those, per-tree masks change and a real
+        # tree can follow a stump, so flush every iteration.  DART sets 1
+        # too (dropping needs host trees each iteration), and
+        # train_one_iter forces a flush when gradients come from a custom
+        # objective (their evolution is outside the soundness argument).
+        deferrable = (self.num_class == 1 and not self.bagging_enabled
+                      and config.feature_fraction >= 1.0)
+        self._flush_every = 16 if deferrable else 1
         self.bag_rng = Mt19937Random(config.bagging_seed)
         self.bag_masks = []
         for _ in range(self.num_class):
@@ -305,7 +306,8 @@ class GBDT:
                 grad[cls], hess[cls], self._bag_mask_dev(cls), fmask, cls))
         self.iter += 1
         self.num_used_model = len(self._models) // self.num_class
-        if is_eval or self.iter % self._flush_every == 0:
+        custom_grads = gradients is not None
+        if is_eval or custom_grads or self.iter % self._flush_every == 0:
             if self._flush_pending():
                 log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
@@ -384,9 +386,11 @@ class GBDT:
 
     def _flush_pending(self) -> bool:
         """Unpack pending device trees; truncate at the first 1-leaf stump
-        (the reference stops training there, gbdt.cpp:186; every later
-        tree is an identical zero-valued stump, so dropping them is exact).
-        Returns True when training must stop."""
+        (the reference stops training there, gbdt.cpp:186).  Deleted trees
+        that were NOT stumps (possible when a stump appears mid-iteration
+        for one class, or under changing bag/feature masks) have their
+        score contributions subtracted so scores always match the kept
+        model.  Returns True when training must stop."""
         stop_at = None
         for idx, m in enumerate(self._models):
             if not isinstance(m, _PendingTree):
@@ -396,10 +400,30 @@ class GBDT:
             if tree.num_leaves <= 1 and stop_at is None:
                 stop_at = idx
         if stop_at is not None:
+            for idx in range(stop_at, len(self._models)):
+                t = self._models[idx]
+                if t.num_leaves > 1:
+                    self._subtract_tree_scores(t, idx % self.num_class)
             del self._models[stop_at:]
             self._stopped = True
             self.num_used_model = len(self._models) // self.num_class
+            self.iter = self.num_used_model
         return self._stopped
+
+    def _subtract_tree_scores(self, tree: Tree, cls: int) -> None:
+        """Remove a discarded tree's leaf values from train/valid scores
+        (leaf assignment by binned traversal == the growth-time leaf_id;
+        reverses _train_tree's adds to within one f32 ulp)."""
+        sf = jnp.asarray(tree.split_feature)
+        tb = jnp.asarray(tree.threshold_bin)
+        lc = jnp.asarray(tree.left_child)
+        rc = jnp.asarray(tree.right_child)
+        lv = jnp.asarray(tree.leaf_value.astype(np.float32))  # shrunk already
+        leaf = predict_leaf_binned(sf, tb, lc, rc, self.bins_dev)
+        self.scores = self.scores.at[cls].add(-lv[leaf])
+        for i, vbins in enumerate(self.valid_bins_dev):
+            vleaf = predict_leaf_binned(sf, tb, lc, rc, vbins)
+            self.valid_scores[i] = self.valid_scores[i].at[cls].add(-lv[vleaf])
 
     def _unpack_tree(self, p: "_PendingTree") -> Tree:
         L = max(self.config.num_leaves, 2)

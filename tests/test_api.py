@@ -174,3 +174,29 @@ def test_train_convenience_early_stopping(train_ds):
         early_stopping_rounds=5, verbose_eval=False)
     # aggressive LR must overfit and stop well before 200 rounds
     assert booster.current_iteration < 200
+
+
+def test_stump_stop_scores_match_model():
+    """When training stops at a 1-leaf stump, the truncated model and the
+    internal score vector must agree (deleted trees' contributions are
+    rolled back by the flush)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(400, 3)
+    y = (x[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(x, label=y)
+    # huge min_gain: the first tree or two may split, then nothing meets
+    # the bar and a stump stops training well before 50 iterations
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "min_gain_to_split": 1e6, "min_data_in_leaf": 1,
+                     "metric": "l2", "bagging_fraction": 0.5,
+                     "bagging_freq": 1, "bagging_seed": 7},
+                    ds, num_boost_round=50, verbose_eval=False)
+    gbdt = bst._gbdt
+    ntrees = len(bst._gbdt.models)
+    assert ntrees < 50
+    # scores == sum of kept trees' predictions on the training data
+    pred = bst.predict(x, raw_score=True)
+    internal = np.asarray(gbdt._training_score())
+    np.testing.assert_allclose(internal, pred, rtol=1e-5, atol=1e-6)

@@ -90,7 +90,7 @@ def check_against_golden(results, golden, iters, atol=5e-7):
     assert checked >= iters  # at least one metric per iteration
 
 
-def check_model_trees(booster, golden_name, num_trees):
+def check_model_trees(booster, golden_name, num_trees, rtol=5e-6):
     """Model parity for the trained trees: integer/structure fields must be
     byte-identical; float fields may differ in the last printed digit (6
     significant digits; f64 summation-order vs the reference's sequential
@@ -108,7 +108,7 @@ def check_model_trees(booster, golden_name, num_trees):
         for key in ("split_gain", "leaf_value", "internal_value"):
             a = np.array(ours[key].split(), dtype=np.float64)
             b = np.array(want[key].split(), dtype=np.float64)
-            np.testing.assert_allclose(a, b, rtol=5e-6,
+            np.testing.assert_allclose(a, b, rtol=rtol,
                                        err_msg="tree %d %s" % (i, key))
 
 
@@ -155,3 +155,61 @@ def test_lambdarank_parity():
                                            "lambdarank_train.log"))
     check_against_golden(results, golden, iters)
     check_model_trees(booster, "golden_lambdarank_model.txt", iters)
+
+
+@pytest.mark.slow
+def test_dart_parity():
+    """DART trajectory + final model vs the reference binary
+    (tests/golden/dart_train.log, 6 iters of the binary example config
+    with boosting_type=dart: exercises tree dropping, 1/(1+k) shrinkage,
+    normalization, bagging_freq=5 and feature_fraction=0.8 RNG parity)."""
+    iters = 6
+    booster, results = run_example("binary_classification", "binary.train",
+                                   "binary.test", iters,
+                                   extra=("boosting_type=dart",))
+    golden = parse_golden_log(os.path.join(GOLDEN_DIR, "dart_train.log"))
+    check_against_golden(results, golden, iters)
+    # DART's repeated drop/normalize rescaling amplifies last-printed-digit
+    # rounding drift, so the float tolerance is a notch looser here
+    check_model_trees(booster, "golden_dart_model.txt", iters, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_continued_training(tmp_path):
+    """input_model resume: predict init scores with the old model, then
+    keep boosting (application.cpp:106-180).  The reference BINARY cannot
+    produce a golden here — its Predictor for continued training is a
+    stack object whose predict closure dangles (application.cpp:112-114
+    use-after-free segfault) — so this asserts our own semantics: the
+    saved continued model extends the base model and keeps improving.
+    """
+    from lightgbm_tpu.cli import Application
+
+    ex = os.path.join(EXAMPLES, "binary_classification")
+    base = str(tmp_path / "base.txt")
+    final = str(tmp_path / "final.txt")
+    common = ["config=" + os.path.join(ex, "train.conf"),
+              "data=" + os.path.join(ex, "binary.train"),
+              "valid_data=" + os.path.join(ex, "binary.test"),
+              "hist_dtype=float64", "is_save_binary_file=false",
+              "metric_freq=100"]
+    app_base = Application(common + ["num_trees=3", "output_model=" + base])
+    app_base.run()
+    app = Application(common + ["num_trees=3", "input_model=" + base,
+                                "output_model=" + final])
+    app.run()
+
+    base_txt = open(base).read()
+    final_txt = open(final).read()
+    assert base_txt.count("Tree=") == 3
+    assert final_txt.count("Tree=") == 6
+    # the base trees carry over byte-identically
+    base_trees = base_txt.split("Tree=")[1:4]
+    final_trees = final_txt.split("Tree=")[1:7]
+    for b, f in zip(base_trees, final_trees[:3]):
+        assert b.split("\n\n")[0].strip() == f.split("\n\n")[0].strip()
+    # resuming improves the valid logloss over the base model (metric
+    # order follows the config: binary_logloss, auc)
+    base_ll = app_base.boosting.get_eval_at(1)[0]
+    cont_ll = app.boosting.get_eval_at(1)[0]
+    assert cont_ll < base_ll
