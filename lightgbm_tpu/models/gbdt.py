@@ -387,10 +387,14 @@ class GBDT:
     def _flush_pending(self) -> bool:
         """Unpack pending device trees; truncate at the first 1-leaf stump
         (the reference stops training there, gbdt.cpp:186).  Deleted trees
-        that were NOT stumps (possible when a stump appears mid-iteration
-        for one class, or under changing bag/feature masks) have their
-        score contributions subtracted so scores always match the kept
-        model.  Returns True when training must stop."""
+        that were NOT stumps (possible under changing bag/feature masks)
+        have their score contributions subtracted so scores match the kept
+        trees.  A multiclass stop mid-iteration keeps that iteration's
+        earlier-class trees in the model AND in the scores even though
+        prediction floors them away — exactly the reference's behavior
+        (models_ keeps partials, gbdt.cpp:186-197; prediction floors
+        num_used_model_ = size/num_class, gbdt.cpp:455,489).  Returns True
+        when training must stop."""
         stop_at = None
         for idx, m in enumerate(self._models):
             if not isinstance(m, _PendingTree):
@@ -414,16 +418,27 @@ class GBDT:
         """Remove a discarded tree's leaf values from train/valid scores
         (leaf assignment by binned traversal == the growth-time leaf_id;
         reverses _train_tree's adds to within one f32 ulp)."""
+        self._add_tree_to_scores(tree, cls, -1.0, train=True, valid=True)
+
+    def _add_tree_to_scores(self, tree: Tree, cls: int, scale: float,
+                            train: bool, valid: bool) -> None:
+        """Add scale * tree's (already-shrunk) leaf values to the train
+        and/or valid score vectors via binned traversal on device.  Used
+        by the stump-stop rollback and DART's drop/normalize cycle
+        (dart.hpp:86-129)."""
         sf = jnp.asarray(tree.split_feature)
         tb = jnp.asarray(tree.threshold_bin)
         lc = jnp.asarray(tree.left_child)
         rc = jnp.asarray(tree.right_child)
-        lv = jnp.asarray(tree.leaf_value.astype(np.float32))  # shrunk already
-        leaf = predict_leaf_binned(sf, tb, lc, rc, self.bins_dev)
-        self.scores = self.scores.at[cls].add(-lv[leaf])
-        for i, vbins in enumerate(self.valid_bins_dev):
-            vleaf = predict_leaf_binned(sf, tb, lc, rc, vbins)
-            self.valid_scores[i] = self.valid_scores[i].at[cls].add(-lv[vleaf])
+        lv = jnp.asarray((tree.leaf_value * scale).astype(np.float32))
+        if train:
+            leaf = predict_leaf_binned(sf, tb, lc, rc, self.bins_dev)
+            self.scores = self.scores.at[cls].add(lv[leaf])
+        if valid:
+            for i, vbins in enumerate(self.valid_bins_dev):
+                vleaf = predict_leaf_binned(sf, tb, lc, rc, vbins)
+                self.valid_scores[i] = (
+                    self.valid_scores[i].at[cls].add(lv[vleaf]))
 
     def _unpack_tree(self, p: "_PendingTree") -> Tree:
         L = max(self.config.num_leaves, 2)
@@ -686,26 +701,6 @@ class DART(GBDT):
         if is_eval:
             return self.eval_and_check_early_stopping()
         return False
-
-    def _add_tree_to_scores(self, tree: Tree, cls: int, scale: float,
-                            train: bool, valid: bool) -> None:
-        if train:
-            leaf = np.asarray(predict_leaf_binned(
-                jnp.asarray(tree.split_feature), jnp.asarray(tree.threshold_bin),
-                jnp.asarray(tree.left_child), jnp.asarray(tree.right_child),
-                self.bins_dev))
-            vals = (tree.leaf_value * scale).astype(np.float32)
-            self.scores = self.scores.at[cls].add(jnp.asarray(vals[leaf]))
-        if valid:
-            for i, vbins in enumerate(self.valid_bins_dev):
-                leaf = np.asarray(predict_leaf_binned(
-                    jnp.asarray(tree.split_feature),
-                    jnp.asarray(tree.threshold_bin),
-                    jnp.asarray(tree.left_child),
-                    jnp.asarray(tree.right_child), vbins))
-                vv = (tree.leaf_value * scale).astype(np.float32)[leaf]
-                self.valid_scores[i] = (
-                    self.valid_scores[i].at[cls].add(jnp.asarray(vv)))
 
     def _dropping_trees(self) -> None:
         """dart.hpp:86-110: drop trees from the train score, set shrinkage."""

@@ -178,25 +178,59 @@ def test_train_convenience_early_stopping(train_ds):
 
 def test_stump_stop_scores_match_model():
     """When training stops at a 1-leaf stump, the truncated model and the
-    internal score vector must agree (deleted trees' contributions are
-    rolled back by the flush)."""
+    internal score vector must agree (stumps contribute exactly zero)."""
     import lightgbm_tpu as lgb
 
     rng = np.random.RandomState(0)
     x = rng.randn(400, 3)
     y = (x[:, 0] > 0).astype(np.float64)
     ds = lgb.Dataset(x, label=y)
-    # huge min_gain: the first tree or two may split, then nothing meets
-    # the bar and a stump stops training well before 50 iterations
+    # huge min_gain: nothing ever meets the bar, the first tree is a
+    # stump and training stops immediately with an empty model
     bst = lgb.train({"objective": "regression", "num_leaves": 8,
                      "min_gain_to_split": 1e6, "min_data_in_leaf": 1,
                      "metric": "l2", "bagging_fraction": 0.5,
                      "bagging_freq": 1, "bagging_seed": 7},
                     ds, num_boost_round=50, verbose_eval=False)
     gbdt = bst._gbdt
-    ntrees = len(bst._gbdt.models)
-    assert ntrees < 50
-    # scores == sum of kept trees' predictions on the training data
+    assert len(gbdt.models) < 50
     pred = bst.predict(x, raw_score=True)
     internal = np.asarray(gbdt._training_score())
     np.testing.assert_allclose(internal, pred, rtol=1e-5, atol=1e-6)
+
+
+def test_subtract_tree_scores_rolls_back_exactly():
+    """The stump-stop rollback (_subtract_tree_scores) must reverse a
+    tree's contribution to the train and valid score vectors."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(500, 4)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float64)
+    xv = rng.randn(200, 4)
+    yv = (xv[:, 0] + 0.3 * xv[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(x, label=y)
+    vs = lgb.Dataset(xv, label=yv, reference=ds)
+    bst = lgb.train({"objective": "binary", "num_leaves": 8,
+                     "min_data_in_leaf": 5, "metric": "binary_logloss"},
+                    ds, num_boost_round=2, valid_sets=[vs],
+                    verbose_eval=False)
+    gbdt = bst._gbdt
+    before_train = np.asarray(gbdt.scores).copy()
+    before_valid = np.asarray(gbdt.valid_scores[0]).copy()
+    tree = gbdt.models[-1]
+    assert tree.num_leaves > 1
+    gbdt._subtract_tree_scores(tree, 0)
+    after_train = np.asarray(gbdt.scores)
+    after_valid = np.asarray(gbdt.valid_scores[0])
+    # after removal, scores equal the 1-tree ensemble's predictions
+    one_tree_train = gbdt.models[0].predict(x).astype(np.float32)
+    one_tree_valid = gbdt.models[0].predict(xv).astype(np.float32)
+    n = len(y)
+    np.testing.assert_allclose(after_train[0, :n], one_tree_train,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(after_valid[0], one_tree_valid,
+                               rtol=1e-5, atol=1e-6)
+    # and it actually changed something
+    assert not np.allclose(before_train, after_train)
+    assert not np.allclose(before_valid, after_valid)

@@ -213,3 +213,32 @@ def test_continued_training(tmp_path):
     base_ll = app_base.boosting.get_eval_at(1)[0]
     cont_ll = app.boosting.get_eval_at(1)[0]
     assert cont_ll < base_ll
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("example,test_file,model,golden_out,mode", [
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_normal.txt", ()),
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_raw.txt", ("is_predict_raw_score=true",)),
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_leaf.txt", ("is_predict_leaf_index=true",)),
+    ("multiclass_classification", "multiclass.test",
+     "golden_multiclass_model.txt", "pred_multiclass_normal.txt", ()),
+])
+def test_predict_task_parity(tmp_path, example, test_file, model,
+                             golden_out, mode):
+    """task=predict over a reference-trained model must write the exact
+    bytes the reference binary writes (Predictor formatting incl. %g
+    floats and tab joins, predictor.hpp:82-130) in normal / raw-score /
+    leaf-index modes."""
+    from lightgbm_tpu.cli import Application
+
+    out = str(tmp_path / "out.txt")
+    Application(["task=predict",
+                 "data=" + os.path.join(EXAMPLES, example, test_file),
+                 "input_model=" + os.path.join(GOLDEN_DIR, model),
+                 "output_result=" + out, *mode]).run()
+    got = open(out).read()
+    want = open(os.path.join(GOLDEN_DIR, golden_out)).read()
+    assert got == want
