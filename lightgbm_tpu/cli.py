@@ -49,6 +49,18 @@ class Application:
     # ------------------------------------------------------------------
     def init_train(self) -> None:
         cfg = self.config
+        # multi-host: bring up the JAX distributed runtime from the
+        # machine list (replaces Network::Init, application.cpp:185).
+        # Each process loads its row shard (query-granular for ranking)
+        # and device placement goes through make_array_from_process_local
+        # _data (parallel/mesh.py _put_sharded).  NOTE: objectives and
+        # metrics currently reduce over process-LOCAL rows only — global
+        # label statistics / metric reductions across hosts are not wired
+        # yet, so multi-host training is experimental.
+        self.rank, self.num_machines = 0, 1
+        if cfg.num_machines > 1:
+            from .parallel.dist import init_distributed
+            self.rank, self.num_machines = init_distributed(cfg)
         self.boosting_old: Optional[GBDT] = None
         if cfg.input_model:
             # continued training (application.cpp:106-180): predict init
@@ -59,7 +71,8 @@ class Application:
 
         self.objective = create_objective(cfg)
         start = time.time()
-        self.train_data = load_dataset(cfg.data, cfg)
+        self.train_data = load_dataset(cfg.data, cfg, rank=self.rank,
+                                       num_shards=self.num_machines)
         if self.boosting_old is not None:
             self._set_init_scores(self.train_data, cfg.data)
         self.train_metrics = []

@@ -144,3 +144,82 @@ def find_bins(sample_matrix: np.ndarray, total_sample_cnt: int,
     """FindBin over every column of a dense sample matrix [S, C]."""
     return [find_bin(sample_matrix[:, j], total_sample_cnt, max_bin)
             for j in range(sample_matrix.shape[1])]
+
+
+def pack_bin_mappers(mappers: List[BinMapper], max_bin: int) -> np.ndarray:
+    """Fixed-size serialization [len(mappers), 3 + max_bin] f64 rows
+    (num_bin, is_trivial, sparse_rate, padded upper bounds) — the analogue
+    of BinMapper::CopyTo's wire format (reference src/io/bin.cpp:168-187),
+    sized for allgather like SizeForSpecificBin (bin.cpp:159-166)."""
+    out = np.full((len(mappers), 3 + max_bin), np.inf, dtype=np.float64)
+    for i, m in enumerate(mappers):
+        out[i, 0] = m.num_bin
+        out[i, 1] = 1.0 if m.is_trivial else 0.0
+        out[i, 2] = m.sparse_rate
+        out[i, 3:3 + m.num_bin] = m.bin_upper_bound
+    return out
+
+
+def unpack_bin_mappers(packed: np.ndarray) -> List[BinMapper]:
+    out = []
+    for row in packed:
+        nb = int(row[0])
+        out.append(BinMapper(bin_upper_bound=row[3:3 + nb].copy(),
+                             num_bin=nb, is_trivial=row[1] != 0.0,
+                             sparse_rate=float(row[2])))
+    return out
+
+
+def feature_slices(num_features: int, num_machines: int) -> List[slice]:
+    """Contiguous feature ranges per rank, ceil-sized like the reference's
+    start/len split (dataset_loader.cpp:654-667)."""
+    step = max(1, (num_features + num_machines - 1) // num_machines)
+    out = []
+    start = 0
+    for _ in range(num_machines):
+        stop = min(start + step, num_features)
+        out.append(slice(start, stop))
+        start = stop
+    return out
+
+
+def find_bins_distributed(sample_matrix: np.ndarray, total_sample_cnt: int,
+                          max_bin: int, rank: int, num_machines: int,
+                          allgather=None) -> List[BinMapper]:
+    """Distributed FindBin (reference dataset_loader.cpp:650-709): this
+    rank quantizes only its contiguous feature slice from its LOCAL row
+    sample, then an allgather of the serialized mappers gives every rank
+    the full, identical mapper set.
+
+    allgather: f(packed [rows, width]) -> [num_machines, rows, width]
+    stacked across ranks; defaults to the jax multihost allgather
+    (parallel.dist.process_allgather).  Each rank's packed block is padded
+    to the widest slice so the gathered shape is uniform.
+    """
+    if allgather is None:
+        import jax
+        if jax.process_count() != num_machines:
+            # not actually running num_machines processes (single-host
+            # test/dev): quantize everything locally instead
+            from ..utils import log
+            log.warning("Parallel bin finding: %d processes attached but "
+                        "num_machines=%d; falling back to local FindBin"
+                        % (jax.process_count(), num_machines))
+            return find_bins(sample_matrix, total_sample_cnt, max_bin)
+        from ..parallel.dist import process_allgather as allgather
+    f = sample_matrix.shape[1]
+    slices = feature_slices(f, num_machines)
+    mine = slices[rank]
+    local = find_bins(sample_matrix[:, mine], total_sample_cnt, max_bin)
+    packed = pack_bin_mappers(local, max_bin)
+    step = max(len(range(s.start, s.stop)) for s in slices)
+    if packed.shape[0] < step:   # uniform block shape for the allgather
+        pad = np.zeros((step - packed.shape[0], packed.shape[1]))
+        packed = np.concatenate([packed, pad])
+    gathered = np.asarray(allgather(packed))   # [R, step, width]
+    parts = []
+    for r, s in enumerate(slices):
+        cnt = s.stop - s.start
+        if cnt > 0:
+            parts.append(gathered[r, :cnt])
+    return unpack_bin_mappers(np.concatenate(parts))

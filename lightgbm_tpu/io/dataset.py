@@ -171,12 +171,6 @@ def load_dataset(filename: str, config: Config,
 
     label, feats, fmt = parse_file_bytes(raw, label_idx)
     n_total = len(label)
-
-    if num_shards > 1 and not config.is_pre_partition:
-        keep = np.arange(n_total) % num_shards == rank
-        label, feats = label[keep], feats[keep]
-
-    n = len(label)
     ncols = feats.shape[1]
 
     # weight / group columns (indices are original-column space; shift past
@@ -199,7 +193,8 @@ def load_dataset(filename: str, config: Config,
         qid = feats[:, group_idx].astype(np.int64)
         # per-row query ids -> boundaries (reference metadata.cpp:66-92)
         change = np.nonzero(np.diff(qid))[0] + 1
-        query_boundaries = np.concatenate([[0], change, [n]]).astype(np.int32)
+        query_boundaries = np.concatenate(
+            [[0], change, [n_total]]).astype(np.int32)
         drop_cols.add(group_idx)
 
     ignore = set()
@@ -212,7 +207,8 @@ def load_dataset(filename: str, config: Config,
         else:
             ignore.update(int(x) for x in spec.split(",") if x.strip())
 
-    # sidecar files override/augment (reference metadata.cpp:252-327)
+    # sidecar files override/augment (reference metadata.cpp:252-327),
+    # loaded full-length BEFORE any row sharding so they stay row-aligned
     w = _load_sidecar(filename + ".weight")
     if w is not None:
         weights = w.astype(np.float32)
@@ -224,6 +220,32 @@ def load_dataset(filename: str, config: Config,
             [[0], np.cumsum(counts)]).astype(np.int32)
         log.info("Loading query boundaries...")
     init = _load_sidecar(filename + ".init")
+
+    # distributed row sharding: whole queries go to one rank when query
+    # info exists (the reference partitions query-granularly,
+    # dataset_loader.cpp:467-572); labels, features and ALL metadata
+    # shard with the same mask (Metadata::CheckOrPartition)
+    if num_shards > 1 and not config.is_pre_partition:
+        if query_boundaries is not None:
+            nq = len(query_boundaries) - 1
+            qsel = np.arange(nq) % num_shards == rank
+            keep = np.zeros(n_total, dtype=bool)
+            for qi in np.nonzero(qsel)[0]:
+                keep[query_boundaries[qi]:query_boundaries[qi + 1]] = True
+            counts = np.diff(query_boundaries)[qsel]
+            query_boundaries = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int32)
+        else:
+            keep = np.arange(n_total) % num_shards == rank
+        label, feats = label[keep], feats[keep]
+        if weights is not None:
+            weights = weights[keep]
+        if init is not None and n_total:
+            k = max(1, len(init) // n_total)
+            init = np.ascontiguousarray(
+                np.asarray(init).reshape(k, n_total)[:, keep]).reshape(-1)
+
+    n = len(label)
 
     metadata = Metadata(label=label.astype(np.float32), weights=weights,
                         query_boundaries=query_boundaries, init_score=init)
@@ -253,13 +275,23 @@ def load_dataset(filename: str, config: Config,
     else:
         sample = feats
 
-    mappers_all: List[Optional[BinMapper]] = []
-    for j in range(ncols):
-        if j in drop_cols or j in ignore:
-            mappers_all.append(None)
-            continue
-        mappers_all.append(find_bin(sample[:, j], sample.shape[0],
-                                    config.max_bin))
+    used_cols = [j for j in range(ncols)
+                 if j not in drop_cols and j not in ignore]
+    mappers_all: List[Optional[BinMapper]] = [None] * ncols
+    if num_shards > 1 and config.is_parallel_find_bin:
+        # distributed bin finding: each rank quantizes a feature slice of
+        # its local sample, allgather makes the mapper set identical
+        # everywhere (reference dataset_loader.cpp:650-709)
+        from .binning import find_bins_distributed
+        dist_mappers = find_bins_distributed(
+            sample[:, used_cols], sample.shape[0], config.max_bin,
+            rank, num_shards)
+        for j, m in zip(used_cols, dist_mappers):
+            mappers_all[j] = m
+    else:
+        for j in used_cols:
+            mappers_all[j] = find_bin(sample[:, j], sample.shape[0],
+                                      config.max_bin)
 
     used_feature_map = np.full(ncols, -1, dtype=np.int32)
     bin_mappers: List[BinMapper] = []

@@ -49,14 +49,31 @@ def padded_size(n: int, num_shards: int) -> int:
     return ((n + num_shards - 1) // num_shards) * num_shards
 
 
+def _put_sharded(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Place a host array with the given sharding.
+
+    Single-process: arr is the GLOBAL array -> device_put.  Multi-host
+    (jax.process_count() > 1): arr is this PROCESS'S row shard of the
+    global array (each host loaded its own rows, io/dataset.py rank
+    sharding) -> jax.make_array_from_process_local_data assembles the
+    global sharded array without any cross-host copy.  device_put would
+    be WRONG there: it treats its input as the same global value on every
+    process."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    return jax.device_put(arr, sharding)
+
+
 def _pad_rows_and_put(arr: np.ndarray, n_pad: int, fill, mesh: Mesh,
                       spec: P) -> jax.Array:
-    """Pad the last (row) axis to n_pad and place with the given spec."""
+    """Pad the last (row) axis to n_pad (this process's share of the
+    global padded size under multi-host) and place with the given spec."""
     pad = n_pad - arr.shape[-1]
     if pad:
         arr = np.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, pad)],
                      constant_values=fill)
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return _put_sharded(arr, mesh, spec)
 
 
 def _sharded_grow_fn(mesh: Mesh, grow_kw: dict, in_specs, leaf_id_spec: P):
@@ -108,7 +125,7 @@ class ShardedGrower:
         pad = padded_size(n, self.num_shards) - n
         if pad:
             bins = np.pad(bins, ((0, 0), (0, pad)))
-        return jax.device_put(bins, self.bins_sharding())
+        return _put_sharded(bins, self.mesh, P(None, DATA_AXIS))
 
     def shard_rows(self, arr: np.ndarray, n_pad: int, fill=0) -> jax.Array:
         return _pad_rows_and_put(

@@ -280,3 +280,123 @@ def test_voting_parallel_small_k_trains_well():
     m.init("train", ds.metadata, n)
     auc = m.eval(np.asarray(booster._training_score()))[0]
     assert auc > 0.95
+
+
+def test_machine_list_and_rank_inference(tmp_path):
+    from lightgbm_tpu.parallel.dist import infer_rank, parse_machine_list
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    f = tmp_path / "mlist.txt"
+    f.write_text("# cluster\n10.0.0.1 12400\n10.0.0.2 12400\n"
+                 "127.0.0.1 12400\n127.0.0.1 12500\n")
+    machines = parse_machine_list(str(f))
+    assert machines == [("10.0.0.1", 12400), ("10.0.0.2", 12400),
+                        ("127.0.0.1", 12400), ("127.0.0.1", 12500)]
+    # same-ip ranks disambiguated by port (linkers_socket.cpp:49-77)
+    assert infer_rank(machines, 12400, ["127.0.0.1"]) == 2
+    assert infer_rank(machines, 12500, ["127.0.0.1"]) == 3
+    assert infer_rank(machines, 12400, ["10.0.0.2"]) == 1
+    with pytest.raises(LightGBMError):
+        infer_rank(machines, 12400, ["192.168.9.9"])
+
+
+def test_distributed_find_bin_matches_serial():
+    """R ranks, each quantizing a feature slice of the SAME sample, must
+    reproduce the serial mapper set exactly after the allgather
+    (dataset_loader.cpp:650-709 semantics)."""
+    from lightgbm_tpu.io.binning import (find_bins, find_bins_distributed,
+                                         feature_slices)
+
+    rng = np.random.RandomState(0)
+    ncols, nrows, R = 11, 400, 4
+    x = np.concatenate([rng.randn(nrows, ncols - 2),
+                        rng.randint(0, 3, size=(nrows, 2)).astype(float)],
+                       axis=1)
+    serial = find_bins(x, nrows, 32)
+
+    # simulate the allgather with the CALLERS' real padded payloads:
+    # first collect every rank's packed block, then answer with the stack
+    blocks = {}
+
+    def collect_for(rank):
+        def fake(packed):
+            blocks[rank] = np.array(packed)
+            raise _Collected()
+        return fake
+
+    class _Collected(Exception):
+        pass
+
+    for rank in range(R):
+        try:
+            find_bins_distributed(x, nrows, 32, rank, R,
+                                  allgather=collect_for(rank))
+        except _Collected:
+            pass
+    stacked = np.stack([blocks[r] for r in range(R)])
+
+    for rank in range(R):
+        got = find_bins_distributed(x, nrows, 32, rank, R,
+                                    allgather=lambda _: stacked)
+        assert len(got) == len(serial)
+        for g, s in zip(got, serial):
+            assert g.num_bin == s.num_bin
+            assert g.is_trivial == s.is_trivial
+            np.testing.assert_array_equal(g.bin_upper_bound,
+                                          s.bin_upper_bound)
+
+
+def test_feature_slices_cover_all():
+    from lightgbm_tpu.io.binning import feature_slices
+    for f in (1, 2, 7, 8, 28, 100):
+        for r in (1, 2, 3, 8):
+            sl = feature_slices(f, r)
+            assert len(sl) == r
+            cover = [j for s in sl for j in range(s.start, s.stop)]
+            assert cover == list(range(f))
+
+
+def test_row_sharding_aligns_sidecars_and_queries(tmp_path):
+    """Distributed loading must shard weights/init sidecars with the rows
+    and assign WHOLE queries to a rank (dataset_loader.cpp:467-572,
+    metadata.cpp CheckOrPartition)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import load_dataset
+
+    rng = np.random.RandomState(0)
+    n = 101
+    f = tmp_path / "train.tsv"
+    lines = ["%d\t%f\t%f" % (rng.randint(2), rng.randn(), rng.randn())
+             for _ in range(n)]
+    f.write_text("\n".join(lines) + "\n")
+    (tmp_path / "train.tsv.weight").write_text(
+        "\n".join("%f" % (i + 1) for i in range(n)) + "\n")
+    cfg = Config.from_params({"is_save_binary_file": "false"})
+    ds0 = load_dataset(str(f), cfg, rank=0, num_shards=2)
+    ds1 = load_dataset(str(f), cfg, rank=1, num_shards=2)
+    assert ds0.num_data + ds1.num_data == n
+    assert len(ds0.metadata.weights) == ds0.num_data
+    assert len(ds1.metadata.weights) == ds1.num_data
+    # weights follow their rows (row i has weight i+1)
+    np.testing.assert_allclose(ds0.metadata.weights,
+                               np.arange(0, n, 2, dtype=np.float32) + 1)
+    np.testing.assert_allclose(ds1.metadata.weights,
+                               np.arange(1, n, 2, dtype=np.float32) + 1)
+
+    # ranking: whole queries per rank
+    counts = [7, 5, 9, 4, 11, 6, 8, 3, 10, 2]   # sums to 65
+    nq_rows = sum(counts)
+    f2 = tmp_path / "rank.tsv"
+    f2.write_text("\n".join(
+        "%d\t%f" % (rng.randint(3), rng.randn())
+        for _ in range(nq_rows)) + "\n")
+    (tmp_path / "rank.tsv.query").write_text(
+        "\n".join(str(c) for c in counts) + "\n")
+    r0 = load_dataset(str(f2), cfg, rank=0, num_shards=2)
+    r1 = load_dataset(str(f2), cfg, rank=1, num_shards=2)
+    np.testing.assert_array_equal(np.diff(r0.metadata.query_boundaries),
+                                  counts[0::2])
+    np.testing.assert_array_equal(np.diff(r1.metadata.query_boundaries),
+                                  counts[1::2])
+    assert r0.num_data == sum(counts[0::2])
+    assert r1.num_data == sum(counts[1::2])
