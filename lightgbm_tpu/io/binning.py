@@ -198,13 +198,20 @@ def find_bins_distributed(sample_matrix: np.ndarray, total_sample_cnt: int,
     """
     if allgather is None:
         import jax
-        if jax.process_count() != num_machines:
-            # not actually running num_machines processes (single-host
-            # test/dev): quantize everything locally instead
-            from ..utils import log
-            log.warning("Parallel bin finding: %d processes attached but "
+
+        from ..utils import log
+        pc = jax.process_count()
+        if pc != num_machines:
+            if pc > 1:
+                # divergent mappers across live ranks would silently train
+                # a wrong model — refuse
+                log.fatal("Parallel bin finding needs num_machines (%d) "
+                          "processes but %d are attached" % (num_machines,
+                                                             pc))
+            # single-process dev/test: quantize everything locally
+            log.warning("Parallel bin finding: 1 process attached but "
                         "num_machines=%d; falling back to local FindBin"
-                        % (jax.process_count(), num_machines))
+                        % num_machines)
             return find_bins(sample_matrix, total_sample_cnt, max_bin)
         from ..parallel.dist import process_allgather as allgather
     f = sample_matrix.shape[1]

@@ -229,21 +229,25 @@ def load_dataset(filename: str, config: Config,
         if query_boundaries is not None:
             nq = len(query_boundaries) - 1
             qsel = np.arange(nq) % num_shards == rank
-            keep = np.zeros(n_total, dtype=bool)
-            for qi in np.nonzero(qsel)[0]:
-                keep[query_boundaries[qi]:query_boundaries[qi + 1]] = True
-            counts = np.diff(query_boundaries)[qsel]
+            qcounts = np.diff(query_boundaries)
+            keep = np.repeat(qsel, qcounts)
             query_boundaries = np.concatenate(
-                [[0], np.cumsum(counts)]).astype(np.int32)
+                [[0], np.cumsum(qcounts[qsel])]).astype(np.int32)
         else:
             keep = np.arange(n_total) % num_shards == rank
         label, feats = label[keep], feats[keep]
         if weights is not None:
             weights = weights[keep]
         if init is not None and n_total:
-            k = max(1, len(init) // n_total)
-            init = np.ascontiguousarray(
-                np.asarray(init).reshape(k, n_total)[:, keep]).reshape(-1)
+            if len(init) % n_total:
+                # malformed sidecar: same grace as GBDT._init_scores
+                log.warning("Ignoring init score file: %d values do not "
+                            "tile %d rows" % (len(init), n_total))
+                init = None
+            else:
+                k = len(init) // n_total
+                init = np.ascontiguousarray(
+                    np.asarray(init).reshape(k, n_total)[:, keep]).reshape(-1)
 
     n = len(label)
 

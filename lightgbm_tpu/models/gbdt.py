@@ -143,6 +143,7 @@ class GBDT:
         # row block; padded rows are permanently out-of-bag.
         self.grower = None
         self.rows_sharded = False
+        self._mh = False
         if config.tree_learner in ("data", "voting"):
             from ..parallel.mesh import ShardedGrower, make_mesh
             mesh = make_mesh(config.num_shards)
@@ -155,7 +156,21 @@ class GBDT:
                 hist_impl=impl, hist_agg=config.hist_agg)
             row_unit *= self.grower.num_shards
             self.rows_sharded = True
+            # multi-host: every process pads its LOCAL rows to the same
+            # length (max local row count) so the global assembly via
+            # make_array_from_process_local_data has equal blocks; all
+            # other state (scores, objective, metrics, bagging) stays
+            # process-local, matching the reference's locality (its
+            # metrics/objectives never touch Network:: either)
+            self._mh = jax.process_count() > 1
+            if self._mh:
+                from ..parallel.dist import process_allgather
+                all_n = process_allgather(np.asarray([n], dtype=np.int64))
+                self._n_pad_base = int(np.max(all_n))
         elif config.tree_learner == "feature":
+            if jax.process_count() > 1:
+                log.fatal("tree_learner=feature is single-host only; "
+                          "use tree_learner=data for multi-host training")
             from ..parallel.mesh import (FeatureShardedGrower, make_mesh,
                                          FEATURE_AXIS)
             mesh = make_mesh(config.num_shards, FEATURE_AXIS)
@@ -163,7 +178,8 @@ class GBDT:
                 mesh, max_leaves=max(config.num_leaves, 2),
                 max_bin=self.max_bin, params=self.params,
                 max_depth=config.max_depth, hist_impl=impl)
-        self.n_pad = ((n + row_unit - 1) // row_unit) * row_unit
+        n_for_pad = self._n_pad_base if self._mh else n
+        self.n_pad = ((n_for_pad + row_unit - 1) // row_unit) * row_unit
 
         bins = train_data.bins
         if self.n_pad != n:
@@ -174,7 +190,9 @@ class GBDT:
                                   ((0, 0), (0, self.n_pad - n)))
         if self.grower is not None:
             self.bins_dev = self.grower.shard_bins(bins)
-            if self.rows_sharded:
+            if self.rows_sharded and not self._mh:
+                # multi-host keeps scores process-local; single-host
+                # shards them so the leaf_id gather-add stays on-device
                 self.scores = jax.device_put(
                     self.scores, self.grower.row_sharding_2d())
         else:
@@ -328,7 +346,20 @@ class GBDT:
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
-        if self.grower is not None:
+        if self.grower is not None and self._mh:
+            # assemble process-local grad/hess into global sharded arrays,
+            # grow SPMD across hosts, then pull the tree (replicated) and
+            # this process's leaf_id block back to local
+            g = self.grower.shard_rows(
+                np.asarray(grad, dtype=self.dtype), self.n_pad)
+            h = self.grower.shard_rows(
+                np.asarray(hess, dtype=self.dtype), self.n_pad)
+            dev_tree, leaf_id = self.grower.grow(
+                self.bins_dev, g, h, bag_mask_dev,
+                self.grower.replicate(fmask))
+            dev_tree = self.grower.replicated_to_local(dev_tree)
+            leaf_id = self.grower.local_rows(leaf_id)
+        elif self.grower is not None:
             dev_tree, leaf_id = self.grower.grow(
                 self.bins_dev, grad.astype(self.dtype),
                 hess.astype(self.dtype), bag_mask_dev, jnp.asarray(fmask))

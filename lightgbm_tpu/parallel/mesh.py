@@ -56,12 +56,20 @@ def _put_sharded(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
     (jax.process_count() > 1): arr is this PROCESS'S row shard of the
     global array (each host loaded its own rows, io/dataset.py rank
     sharding) -> jax.make_array_from_process_local_data assembles the
-    global sharded array without any cross-host copy.  device_put would
-    be WRONG there: it treats its input as the same global value on every
-    process."""
+    global sharded array without any cross-host copy; the global shape
+    scales the DATA_AXIS dimension by the process count (equal local
+    blocks — GBDT pads every process to the max local row count).
+    device_put would be WRONG there: it treats its input as the same
+    global value on every process."""
     sharding = NamedSharding(mesh, spec)
-    if jax.process_count() > 1:
-        return jax.make_array_from_process_local_data(sharding, arr)
+    pc = jax.process_count()
+    if pc > 1:
+        gshape = list(arr.shape)
+        for dim, axis in enumerate(spec):
+            if axis is not None:
+                gshape[dim] *= pc
+        return jax.make_array_from_process_local_data(sharding, arr,
+                                                      tuple(gshape))
     return jax.device_put(arr, sharding)
 
 
@@ -125,7 +133,7 @@ class ShardedGrower:
         pad = padded_size(n, self.num_shards) - n
         if pad:
             bins = np.pad(bins, ((0, 0), (0, pad)))
-        return _put_sharded(bins, self.mesh, P(None, DATA_AXIS))
+        return _put_sharded(bins, self.mesh, self.bins_sharding().spec)
 
     def shard_rows(self, arr: np.ndarray, n_pad: int, fill=0) -> jax.Array:
         return _pad_rows_and_put(
@@ -134,6 +142,31 @@ class ShardedGrower:
 
     def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
         return self._grow(bins_dev, grad, hess, bag_mask, feature_mask)
+
+    # -- multi-host helpers (jax.process_count() > 1) -------------------
+    def replicate(self, arr) -> jax.Array:
+        """Host array (identical on every process) -> replicated global."""
+        return _put_sharded(np.asarray(arr), self.mesh, P())
+
+    def local_rows(self, garr: jax.Array) -> jax.Array:
+        """This process's contiguous row block of a P(DATA_AXIS)-sharded
+        global array, as a process-local array.  The per-device shards
+        are committed to different local devices, so they concatenate on
+        the host (one [n_local] copy per call)."""
+        if jax.process_count() == 1:
+            return garr
+        pos = {d: i for i, d in enumerate(self.mesh.devices.flat)}
+        shards = sorted(garr.addressable_shards, key=lambda s: pos[s.device])
+        return jnp.asarray(np.concatenate([np.asarray(s.data)
+                                           for s in shards]))
+
+    def replicated_to_local(self, tree):
+        """Fully-replicated global tree arrays -> process-local arrays so
+        they compose with local score/valid tensors."""
+        if jax.process_count() == 1:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a.addressable_data(0)), tree)
 
 
 class FeatureShardedGrower:
