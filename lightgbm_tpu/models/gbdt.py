@@ -51,14 +51,18 @@ class _PendingTree:
 @jax.jit
 def _pack_tree(dev_tree):
     """TreeArrays -> (int32 buffer, float buffer): two flat arrays so a
-    whole tree ships device->host in two async copies instead of eleven."""
+    whole tree ships device->host in two async copies instead of eleven.
+    The trailing dummy slots (grow.py TreeArrays) are trimmed here, so the
+    wire layout stays [1 + 5*(L-1) + 3*L | (L-1) + L + (L-1)]."""
     ints = jnp.concatenate([
-        dev_tree.num_leaves.reshape(1), dev_tree.split_feature,
-        dev_tree.threshold_bin, dev_tree.left_child, dev_tree.right_child,
-        dev_tree.leaf_parent, dev_tree.leaf_depth, dev_tree.leaf_count,
+        dev_tree.num_leaves.reshape(1), dev_tree.split_feature[:-1],
+        dev_tree.threshold_bin[:-1], dev_tree.left_child[:-1],
+        dev_tree.right_child[:-1], dev_tree.leaf_parent[:-1],
+        dev_tree.leaf_depth[:-1], dev_tree.leaf_count[:-1],
     ]).astype(jnp.int32)
-    floats = jnp.concatenate([dev_tree.split_gain, dev_tree.leaf_value,
-                              dev_tree.internal_value])
+    floats = jnp.concatenate([dev_tree.split_gain[:-1],
+                              dev_tree.leaf_value[:-1],
+                              dev_tree.internal_value[:-1]])
     return ints, floats
 
 

@@ -42,59 +42,72 @@ from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
 
 class TreeArrays(NamedTuple):
     """Array-based binary tree, mirroring reference include/LightGBM/tree.h:125-152.
-    Node slots [L-1]; leaves encoded as ~leaf_idx in child pointers."""
-    split_feature: jax.Array    # [L-1] i32 inner (used-feature) index
-    threshold_bin: jax.Array    # [L-1] i32
-    split_gain: jax.Array       # [L-1] f
-    left_child: jax.Array       # [L-1] i32
-    right_child: jax.Array      # [L-1] i32
-    leaf_parent: jax.Array      # [L] i32
-    leaf_value: jax.Array       # [L] f
-    internal_value: jax.Array   # [L-1] f
-    leaf_depth: jax.Array       # [L] i32
-    leaf_count: jax.Array       # [L] i32
+    Leaves encoded as ~leaf_idx in child pointers.  Each array carries one
+    trailing DUMMY slot (node index L-1 / leaf index L) that inactive scan
+    steps write into — real entries are nodes [0, L-2] and leaves [0, L-1];
+    the dummy is unreachable from traversal and trimmed on host export."""
+    split_feature: jax.Array    # [L] i32 inner (used-feature) index
+    threshold_bin: jax.Array    # [L] i32
+    split_gain: jax.Array       # [L] f
+    left_child: jax.Array       # [L] i32
+    right_child: jax.Array      # [L] i32
+    leaf_parent: jax.Array      # [L+1] i32
+    leaf_value: jax.Array       # [L+1] f
+    internal_value: jax.Array   # [L] f
+    leaf_depth: jax.Array       # [L+1] i32
+    leaf_count: jax.Array       # [L+1] i32
     num_leaves: jax.Array       # scalar i32
 
 
 class GrowState(NamedTuple):
     tree: TreeArrays
     leaf_id: jax.Array          # [N] i32
-    hist: jax.Array             # [L, F, B, 3]
-    leaf_sum_g: jax.Array       # [L]
-    leaf_sum_h: jax.Array       # [L]
-    best: BestSplit             # all fields [L]
+    hist: jax.Array             # [L+1, F, B, 3] (last = dummy slot)
+    leaf_sum_g: jax.Array       # [L+1] (last = dummy slot)
+    leaf_sum_h: jax.Array       # [L+1]
+    best_f: jax.Array           # [L+1, 8] float best-split fields
+    best_i: jax.Array           # [L+1, 4] i32 best-split fields
+
+
+# column layout of the packed per-leaf best-split state.  Packing the
+# 11 BestSplit fields into two stacked arrays turns the per-split
+# bookkeeping (2 leaves updated, 1 read) into 6 row-sized ops instead of
+# ~33 scalar gathers/updates — on remote-attached TPUs every extra op in
+# the sequential split chain costs launch latency.
+BF_GAIN, BF_LG, BF_LH, BF_RG, BF_RH, BF_LOUT, BF_ROUT = range(7)
+BI_FEAT, BI_THR, BI_LCNT, BI_RCNT = range(4)
+
+
+def _pack_best(s: BestSplit, dtype):
+    bf = jnp.stack([s.gain.astype(dtype), s.left_sum_g.astype(dtype),
+                    s.left_sum_h.astype(dtype), s.right_sum_g.astype(dtype),
+                    s.right_sum_h.astype(dtype), s.left_output.astype(dtype),
+                    s.right_output.astype(dtype),
+                    jnp.zeros((), dtype)])
+    bi = jnp.stack([s.feature, s.threshold, s.left_count, s.right_count])
+    return bf, bi
 
 
 def _empty_tree(max_leaves: int, dtype) -> TreeArrays:
-    lm1 = max_leaves - 1
+    L = max_leaves
     z_i = functools.partial(jnp.zeros, dtype=jnp.int32)
     z_f = functools.partial(jnp.zeros, dtype=dtype)
     return TreeArrays(
-        split_feature=z_i(lm1), threshold_bin=z_i(lm1), split_gain=z_f(lm1),
-        left_child=z_i(lm1), right_child=z_i(lm1),
-        leaf_parent=jnp.full(max_leaves, -1, dtype=jnp.int32),
-        leaf_value=z_f(max_leaves), internal_value=z_f(lm1),
-        leaf_depth=jnp.ones(max_leaves, dtype=jnp.int32),
-        leaf_count=z_i(max_leaves),
+        split_feature=z_i(L), threshold_bin=z_i(L), split_gain=z_f(L),
+        left_child=z_i(L), right_child=z_i(L),
+        leaf_parent=jnp.full(L + 1, -1, dtype=jnp.int32),
+        leaf_value=z_f(L + 1), internal_value=z_f(L),
+        leaf_depth=jnp.ones(L + 1, dtype=jnp.int32),
+        leaf_count=z_i(L + 1),
         num_leaves=jnp.int32(1),
     )
 
 
-def _empty_best(max_leaves: int, dtype) -> BestSplit:
-    z_i = functools.partial(jnp.zeros, dtype=jnp.int32)
-    z_f = functools.partial(jnp.zeros, dtype=dtype)
-    return BestSplit(
-        gain=jnp.full(max_leaves, K_MIN_SCORE, dtype=dtype),
-        feature=z_i(max_leaves), threshold=z_i(max_leaves),
-        left_count=z_i(max_leaves), right_count=z_i(max_leaves),
-        left_sum_g=z_f(max_leaves), left_sum_h=z_f(max_leaves),
-        right_sum_g=z_f(max_leaves), right_sum_h=z_f(max_leaves),
-        left_output=z_f(max_leaves), right_output=z_f(max_leaves),
-    )
-
-
-def _set_best(best: BestSplit, leaf, s: BestSplit) -> BestSplit:
-    return BestSplit(*[arr.at[leaf].set(v) for arr, v in zip(best, s)])
+def _empty_best_packed(max_leaves: int, dtype):
+    bf = jnp.zeros((max_leaves + 1, 8), dtype=dtype)
+    bf = bf.at[:, BF_GAIN].set(K_MIN_SCORE)
+    bi = jnp.zeros((max_leaves + 1, 4), dtype=jnp.int32)
+    return bf, bi
 
 
 def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
@@ -266,113 +279,121 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
     tree = _empty_tree(max_leaves, dtype)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_cnt))
-    best = _empty_best(max_leaves, dtype)
+    best_f0, best_i0 = _empty_best_packed(max_leaves, dtype)
     root_best = best_of(root_hist, root_cnt, root_g, root_h)
     root_best = root_best._replace(
         gain=depth_gated(root_best.gain, jnp.int32(1)))
-    best = _set_best(best, 0, root_best)
+    rbf, rbi = _pack_best(root_best, dtype)
+    best_f0 = best_f0.at[0].set(rbf)
+    best_i0 = best_i0.at[0].set(rbi)
 
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
-        hist=jnp.zeros((max_leaves, f, max_bin, 3), dtype=dtype)
+        hist=jnp.zeros((max_leaves + 1, f, max_bin, 3), dtype=dtype)
             .at[0].set(root_hist),
-        leaf_sum_g=jnp.zeros(max_leaves, dtype=dtype).at[0].set(root_g),
-        leaf_sum_h=jnp.zeros(max_leaves, dtype=dtype).at[0].set(root_h),
-        best=best,
+        leaf_sum_g=jnp.zeros(max_leaves + 1, dtype=dtype).at[0].set(root_g),
+        leaf_sum_h=jnp.zeros(max_leaves + 1, dtype=dtype).at[0].set(root_h),
+        best_f=best_f0, best_i=best_i0,
     )
-
-    def active(st: GrowState):
-        return ((st.tree.num_leaves < max_leaves)
-                & (jnp.max(st.best.gain) > 0.0))
-
-    def body(st: GrowState) -> GrowState:
-        tree, best = st.tree, st.best
-        # argmax over leaves; first max ⇒ smaller leaf index, matching
-        # ArrayArgs::ArgMax over best_split_per_leaf_ (serial_tree_learner.cpp:121)
-        bl = jnp.argmax(best.gain).astype(jnp.int32)
-        s = jax.tree_util.tree_map(lambda a: a[bl], best)
-
-        node = tree.num_leaves - 1
-        right = tree.num_leaves           # new leaf index
-        parent = tree.leaf_parent[bl]
-
-        # --- Tree::Split (reference src/io/tree.cpp:42-77) ---
-        pidx = jnp.maximum(parent, 0)
-        lc = tree.left_child
-        lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~bl),
-                                       node, lc[pidx]))
-        rc = tree.right_child
-        rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~bl),
-                                       node, rc[pidx]))
-        lc = lc.at[node].set(~bl)
-        rc = rc.at[node].set(~right)
-
-        new_tree = TreeArrays(
-            split_feature=tree.split_feature.at[node].set(s.feature),
-            threshold_bin=tree.threshold_bin.at[node].set(s.threshold),
-            split_gain=tree.split_gain.at[node].set(s.gain),
-            left_child=lc, right_child=rc,
-            leaf_parent=tree.leaf_parent.at[bl].set(node).at[right].set(node),
-            leaf_value=tree.leaf_value.at[bl].set(s.left_output)
-                                      .at[right].set(s.right_output),
-            internal_value=tree.internal_value.at[node].set(
-                tree.leaf_value[bl]),
-            leaf_depth=tree.leaf_depth
-                .at[right].set(tree.leaf_depth[bl] + 1)
-                .at[bl].add(1),
-            leaf_count=tree.leaf_count.at[bl].set(s.left_count)
-                                      .at[right].set(s.right_count),
-            num_leaves=tree.num_leaves + 1,
-        )
-
-        # --- partition: one vectorized compare (replaces DataPartition::Split,
-        # src/treelearner/data_partition.hpp:84-132) ---
-        binrow = feature_bin_row(s.feature)
-        go_right = (st.leaf_id == bl) & (binrow > s.threshold)
-        leaf_id = jnp.where(go_right, right, st.leaf_id)
-
-        # --- histograms: smaller child scanned, larger by subtraction ---
-        left_is_smaller = s.left_count <= s.right_count
-        small_leaf = jnp.where(left_is_smaller, bl, right)
-        small_hist = hist_leaf(leaf_id, small_leaf)
-        large_hist = st.hist[bl] - small_hist
-        left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
-        right_hist = jnp.where(left_is_smaller, large_hist, small_hist)
-        hist = st.hist.at[bl].set(left_hist).at[right].set(right_hist)
-
-        leaf_sum_g = st.leaf_sum_g.at[bl].set(s.left_sum_g) \
-                                  .at[right].set(s.right_sum_g)
-        leaf_sum_h = st.leaf_sum_h.at[bl].set(s.left_sum_h) \
-                                  .at[right].set(s.right_sum_h)
-
-        # --- best splits for the two children ---
-        child_depth = new_tree.leaf_depth[bl]
-        lbest = best_of(left_hist, s.left_count, s.left_sum_g, s.left_sum_h)
-        lbest = lbest._replace(gain=depth_gated(lbest.gain, child_depth))
-        rbest = best_of(right_hist, s.right_count, s.right_sum_g,
-                        s.right_sum_h)
-        rbest = rbest._replace(gain=depth_gated(rbest.gain, child_depth))
-        best = _set_best(_set_best(best, bl, lbest), right, rbest)
-
-        return GrowState(tree=new_tree, leaf_id=leaf_id, hist=hist,
-                         leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
-                         best=best)
 
     # Fixed-trip scan instead of lax.while_loop: a while_loop's per-
     # iteration continuation check serializes against the body's full
     # critical path and costs ~ms/step on remote-attached TPUs, ~8x the
     # body itself.  The scan always runs max_leaves-1 steps; once growth
-    # stops (no positive gain / leaf budget reached) the body's result is
-    # discarded by a select, which preserves the reference's early-stop
-    # semantics (serial_tree_learner.cpp:121-129) at the cost of dead
-    # iterations only for trees that finish early.
+    # stops (no positive gain / leaf budget reached) every update is
+    # redirected to the DUMMY slot (index max_leaves for leaves, the last
+    # node slot for nodes) so the real state passes through untouched —
+    # preserving the reference's early-stop semantics
+    # (serial_tree_learner.cpp:121-129) without a whole-state select.
     def step(st: GrowState, _):
-        new_st = body(st)
-        keep = active(st)
-        st = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(keep, a, b), new_st, st)
-        return st, None
+        tree = st.tree
+        # argmax over leaves; first max ⇒ smaller leaf index, matching
+        # ArrayArgs::ArgMax over best_split_per_leaf_ (serial_tree_learner.cpp:121)
+        bl = jnp.argmax(st.best_f[:max_leaves, BF_GAIN]).astype(jnp.int32)
+        sf = st.best_f[bl]
+        si = st.best_i[bl]
+        s_gain = sf[BF_GAIN]
+        s_feature = si[BI_FEAT]
+        s_threshold = si[BI_THR]
+        keep = (tree.num_leaves < max_leaves) & (s_gain > 0.0)
+
+        node = tree.num_leaves - 1
+        right = tree.num_leaves           # new leaf index
+        # dummy-slot redirection: all writes of an inactive step land in
+        # scratch entries that the output never reads
+        wl = jnp.where(keep, bl, max_leaves)          # leaf-array writes
+        wr = jnp.where(keep, right, max_leaves)
+        wn = jnp.where(keep, node, max_leaves - 1)    # node-array writes
+        parent = tree.leaf_parent[bl]
+
+        # --- Tree::Split (reference src/io/tree.cpp:42-77) ---
+        pidx = jnp.where(keep & (parent >= 0), parent, max_leaves - 1)
+        lc = tree.left_child
+        lc = lc.at[pidx].set(jnp.where(keep & (parent >= 0)
+                                       & (lc[pidx] == ~bl), node, lc[pidx]))
+        rc = tree.right_child
+        rc = rc.at[pidx].set(jnp.where(keep & (parent >= 0)
+                                       & (rc[pidx] == ~bl), node, rc[pidx]))
+        lc = lc.at[wn].set(jnp.where(keep, ~bl, lc[wn]))
+        rc = rc.at[wn].set(jnp.where(keep, ~right, rc[wn]))
+
+        new_tree = TreeArrays(
+            split_feature=tree.split_feature.at[wn].set(
+                jnp.where(keep, s_feature, tree.split_feature[wn])),
+            threshold_bin=tree.threshold_bin.at[wn].set(
+                jnp.where(keep, s_threshold, tree.threshold_bin[wn])),
+            split_gain=tree.split_gain.at[wn].set(
+                jnp.where(keep, s_gain, tree.split_gain[wn])),
+            left_child=lc, right_child=rc,
+            leaf_parent=tree.leaf_parent.at[wl].set(node).at[wr].set(node),
+            leaf_value=tree.leaf_value.at[wl].set(sf[BF_LOUT])
+                                      .at[wr].set(sf[BF_ROUT]),
+            internal_value=tree.internal_value.at[wn].set(
+                jnp.where(keep, tree.leaf_value[bl],
+                          tree.internal_value[wn])),
+            leaf_depth=tree.leaf_depth
+                .at[wr].set(tree.leaf_depth[bl] + 1)
+                .at[wl].add(1),
+            leaf_count=tree.leaf_count.at[wl].set(si[BI_LCNT])
+                                      .at[wr].set(si[BI_RCNT]),
+            num_leaves=tree.num_leaves + keep.astype(jnp.int32),
+        )
+
+        # --- partition: one vectorized compare (replaces DataPartition::Split,
+        # src/treelearner/data_partition.hpp:84-132) ---
+        binrow = feature_bin_row(s_feature)
+        go_right = keep & (st.leaf_id == bl) & (binrow > s_threshold)
+        leaf_id = jnp.where(go_right, right, st.leaf_id)
+
+        # --- histograms: smaller child scanned, larger by subtraction ---
+        left_is_smaller = si[BI_LCNT] <= si[BI_RCNT]
+        small_leaf = jnp.where(left_is_smaller, bl, right)
+        small_hist = hist_leaf(leaf_id, small_leaf)
+        large_hist = st.hist[bl] - small_hist
+        left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_is_smaller, large_hist, small_hist)
+        hist = st.hist.at[wl].set(left_hist).at[wr].set(right_hist)
+
+        leaf_sum_g = st.leaf_sum_g.at[wl].set(sf[BF_LG]) \
+                                  .at[wr].set(sf[BF_RG])
+        leaf_sum_h = st.leaf_sum_h.at[wl].set(sf[BF_LH]) \
+                                  .at[wr].set(sf[BF_RH])
+
+        # --- best splits for the two children ---
+        child_depth = new_tree.leaf_depth[bl]
+        lbest = best_of(left_hist, si[BI_LCNT], sf[BF_LG], sf[BF_LH])
+        lbf, lbi = _pack_best(lbest._replace(
+            gain=depth_gated(lbest.gain, child_depth)), dtype)
+        rbest = best_of(right_hist, si[BI_RCNT], sf[BF_RG], sf[BF_RH])
+        rbf, rbi = _pack_best(rbest._replace(
+            gain=depth_gated(rbest.gain, child_depth)), dtype)
+        best_f = st.best_f.at[wl].set(lbf).at[wr].set(rbf)
+        best_i = st.best_i.at[wl].set(lbi).at[wr].set(rbi)
+
+        return GrowState(tree=new_tree, leaf_id=leaf_id, hist=hist,
+                         leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
+                         best_f=best_f, best_i=best_i), None
 
     final, _ = jax.lax.scan(step, state, None, length=max_leaves - 1)
     return final.tree, final.leaf_id

@@ -3,7 +3,8 @@
 The XLA formulation (ops/histogram.py) materializes per-feature one-hot
 matrices in HBM (~N*B bytes per feature per split), which dominates at
 scale.  This kernel uses a radix decomposition bin = hi*32 + lo and packs
-FEAT_BLOCK=4 features into ONE block-diagonal MXU matmul:
+MM_FEATS=4 features into ONE block-diagonal MXU matmul (a grid step
+covers _feat_block(F) <= MAX_FEAT_BLOCK features, several matmuls):
 
     lhs[(f, c, hi), r] = gh3[c, r] * (bins_hi[f, r] == hi)   [96, blk]
     rhs[r, (f, lo)]    = (bins_lo[f, r] == lo)               [blk, 128]
@@ -36,14 +37,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-FEAT_BLOCK = 8    # features per grid step (Mosaic wants sublane dim % 8)
-MM_FEATS = 4      # features per block-diagonal matmul (2 matmuls per step)
+MAX_FEAT_BLOCK = 16   # features per grid step (gh2/leaf_eff stream from
+                      # HBM once per row block per GRID STEP, so wide
+                      # feature blocks amortize that traffic; sublane
+                      # tiling wants a multiple of 8)
+MM_FEATS = 4      # features per block-diagonal matmul
 N_HI = 8
 N_LO = 32
 N_COMP = 3    # grad, hess, count
 M_ROWS = MM_FEATS * N_COMP * N_HI   # 96
 N_COLS = MM_FEATS * N_LO            # 128
 PALLAS_ROW_BLOCK = 8192   # rows per grid step; N must be a multiple
+
+
+def _feat_block(f: int) -> int:
+    return min(MAX_FEAT_BLOCK, ((f + 7) // 8) * 8)
 
 
 def make_gh2(grad: jax.Array, hess: jax.Array) -> jax.Array:
@@ -58,15 +66,15 @@ def fold_leaf_mask(leaf_id: jax.Array, mask: jax.Array) -> jax.Array:
 
 def _hist_kernel(target_ref, bins_ref, gh_ref, leaf_ref, out_ref):
     r = pl.program_id(1)
-    blk = bins_ref.shape[1]
+    feat_block, blk = bins_ref.shape
     mask = (leaf_ref[:] == target_ref[0]).astype(jnp.float32)    # [blk]
     gh3 = jnp.stack([gh_ref[0, :] * mask, gh_ref[1, :] * mask, mask])
-    bins = bins_ref[...].astype(jnp.int32)                       # [8, blk]
+    bins = bins_ref[...].astype(jnp.int32)                       # [fb, blk]
     hi = bins >> 5
     lo = bins & 31
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (N_HI, blk), 0)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (N_LO, blk), 0)
-    for m in range(FEAT_BLOCK // MM_FEATS):
+    for m in range(feat_block // MM_FEATS):
         lhs_parts = []
         rhs_parts = []
         for f in range(m * MM_FEATS, (m + 1) * MM_FEATS):
@@ -107,10 +115,11 @@ def leaf_histogram_masked(bins_t: jax.Array, gh2: jax.Array,
     f, n = bins_t.shape
     assert n % row_block == 0, (n, row_block)
     assert max_bin <= N_HI * N_LO, max_bin
-    fpad = ((f + FEAT_BLOCK - 1) // FEAT_BLOCK) * FEAT_BLOCK
+    fb = _feat_block(f)
+    fpad = ((f + fb - 1) // fb) * fb
     if fpad != f:
         bins_t = jnp.pad(bins_t, ((0, fpad - f), (0, 0)))
-    groups = fpad // FEAT_BLOCK
+    groups = fpad // fb
     nblocks = n // row_block
     target = jnp.asarray(target_leaf, dtype=jnp.int32).reshape(1)
 
@@ -119,18 +128,18 @@ def leaf_histogram_masked(bins_t: jax.Array, gh2: jax.Array,
         grid=(groups, nblocks),   # row dim minor: out block stays in VMEM
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((FEAT_BLOCK, row_block), lambda i, r: (i, r),
+            pl.BlockSpec((fb, row_block), lambda i, r: (i, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((2, row_block), lambda i, r: (0, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((row_block,), lambda i, r: (r,),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, FEAT_BLOCK // MM_FEATS, M_ROWS, N_COLS),
+        out_specs=pl.BlockSpec((1, fb // MM_FEATS, M_ROWS, N_COLS),
                                lambda i, r: (i, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (groups, FEAT_BLOCK // MM_FEATS, M_ROWS, N_COLS), jnp.float32),
+            (groups, fb // MM_FEATS, M_ROWS, N_COLS), jnp.float32),
         interpret=interpret,
     )(target, bins_t, gh2, leaf_eff)
     # rows are (f, c, hi), cols are (f', lo); feature f's histogram is the
