@@ -29,7 +29,7 @@ import numpy as np
 from ..utils import log
 from ..config import Config
 from .binning import BinMapper, find_bin
-from .parser import parse_file_bytes
+from .parser import detect_format, parse_file_bytes
 
 _BIN_CACHE_VERSION = 1
 
@@ -122,6 +122,306 @@ def _load_sidecar(path: str) -> Optional[np.ndarray]:
     return np.asarray(vals, dtype=np.float64)
 
 
+def _stream_line_chunks(f, chunk_bytes: int = 32 << 20):
+    """Yield byte blocks of complete lines from an open binary file.
+    Line endings are normalized to \\n (accepts \\n, \\r\\n, bare \\r like
+    the one-round header scan); blank lines survive and are filtered by
+    the consumers."""
+    carry = b""
+    while True:
+        buf = f.read(chunk_bytes)
+        if not buf:
+            if carry.strip():
+                yield carry
+            return
+        buf = (carry + buf).replace(b"\r\n", b"\n").replace(b"\r", b"\n")
+        cut = buf.rfind(b"\n")
+        if cut < 0:
+            carry = buf
+            continue
+        yield buf[:cut + 1]
+        carry = buf[cut + 1:]
+
+
+def _skip_header(f, config) -> List[str]:
+    """Position f past the header (first non-blank line when has_header,
+    any of \\n / \\r\\n / \\r endings) and return the parsed column names."""
+    names: List[str] = []
+    if not config.has_header:
+        return names
+    head = f.read(1 << 16)
+    pos = 0
+    first = ""
+    for ln in head.splitlines(keepends=True):
+        pos += len(ln)
+        s = ln.decode("utf-8", "replace").strip()
+        if s:
+            first = s
+            break
+    f.seek(pos)
+    if first:
+        sep = "\t" if "\t" in first else ","
+        names = first.split(sep)
+    return names
+
+
+def _parse_ignore_set(config: Config, names: List[str]) -> set:
+    """ignore_column spec -> set of original column indices."""
+    ignore: set = set()
+    if config.ignore_column:
+        spec = config.ignore_column
+        if spec.startswith("name:"):
+            for nm in spec[5:].split(","):
+                if nm in names:
+                    ignore.add(names.index(nm))
+        else:
+            ignore.update(int(x) for x in spec.split(",") if x.strip())
+    return ignore
+
+
+def _select_used_features(mappers_all, names):
+    """Drop trivial/ignored columns -> (used_feature_map, mappers, real
+    indices), warning like the reference loader."""
+    ncols = len(mappers_all)
+    used_feature_map = np.full(ncols, -1, dtype=np.int32)
+    bin_mappers: List[BinMapper] = []
+    real_index: List[int] = []
+    for j, m in enumerate(mappers_all):
+        if m is None:
+            continue
+        if m.is_trivial:
+            log.warning("Ignoring feature %s, only has one value" % names[j])
+            continue
+        used_feature_map[j] = len(bin_mappers)
+        bin_mappers.append(m)
+        real_index.append(j)
+    return used_feature_map, bin_mappers, real_index
+
+
+def _scan_libsvm_max_idx(chunk: bytes) -> int:
+    """Max feature index in a libsvm chunk (native scan when available)."""
+    from .. import native
+    lib = native.get_lib()
+    if lib is not None:
+        import ctypes
+        rows = ctypes.c_int64()
+        mx = ctypes.c_int64()
+        lib.lgt_scan_libsvm(chunk, len(chunk), ctypes.byref(rows),
+                            ctypes.byref(mx))
+        return int(mx.value)
+    mx = -1
+    for ln in chunk.split(b"\n"):
+        for tok in ln.split():
+            i = tok.find(b":")
+            if i > 0:
+                try:
+                    mx = max(mx, int(tok[:i]))
+                except ValueError:
+                    pass
+    return mx
+
+
+def _load_two_round(filename: str, config: Config, rank: int,
+                    num_shards: int) -> Dataset:
+    """use_two_round_loading: stream the file twice instead of holding the
+    text (and the parsed float matrix) in memory — round 1 counts rows and
+    reservoir-samples lines for bin finding, round 2 re-parses chunk by
+    chunk and quantizes straight into the [F, N] uint8 matrix (reference
+    two-round loading, dataset_loader.cpp:170-185 + TextReader::
+    SampleFromFile).  The structural template for out-of-core-scale
+    ingest: peak memory is one chunk of floats + the binned matrix.
+
+    Row sharding is modulo only; ranking data (query-granular sharding)
+    must use one-round loading."""
+    sample_target = max(1, config.bin_construct_sample_cnt)
+    rng = np.random.RandomState(config.data_random_seed)
+    sharding = num_shards > 1 and not config.is_pre_partition
+
+    # ---- round 1: count rows, reservoir-sample lines ----
+    # block reservoir: assign each line a random key, keep the S smallest
+    # (equivalent to a uniform S-of-N sample, vectorized per chunk)
+    keys = None
+    kept: List[bytes] = []
+    n_total = 0
+    fmt = None
+    libsvm_max_idx = -1
+    with open(filename, "rb") as f:
+        names = _skip_header(f, config)
+        for chunk in _stream_line_chunks(f):
+            lines = [ln for ln in chunk.split(b"\n") if ln.strip()]
+            if not lines:
+                continue
+            if fmt is None:
+                fmt = detect_format([ln.decode("utf-8", "replace")
+                                     for ln in lines[:2]])
+            if fmt == "libsvm":
+                # schema width must come from the WHOLE file, not the
+                # sample — a feature the sample misses must still occupy
+                # its column (it just gets a trivial, ignored mapper)
+                libsvm_max_idx = max(libsvm_max_idx,
+                                     _scan_libsvm_max_idx(chunk))
+            if sharding:
+                # sample only THIS rank's rows, like one-round loading
+                # (shard first, then draw the bin sample from local rows)
+                gidx = np.arange(n_total, n_total + len(lines))
+                n_total += len(lines)
+                sel = (gidx % num_shards) == rank
+                lines = [ln for ln, s in zip(lines, sel) if s]
+                if not lines:
+                    continue
+            else:
+                n_total += len(lines)
+            ck = rng.rand(len(lines))
+            if keys is None:
+                keys = ck
+                kept = lines
+            else:
+                keys = np.concatenate([keys, ck])
+                kept = kept + lines
+            if len(kept) > sample_target:
+                top = np.argpartition(keys, sample_target)[:sample_target]
+                keys = keys[top]
+                kept = [kept[i] for i in top]
+    if n_total == 0:
+        log.fatal("Data file %s is empty" % filename)
+
+    label_idx = _parse_column_spec(config.label_column, names)
+    if label_idx < 0:
+        label_idx = 0
+    sample_raw = b"\n".join(kept) + b"\n"
+    _, sample_feats, fmt = parse_file_bytes(sample_raw, label_idx, fmt)
+    ncols = sample_feats.shape[1]
+    if fmt == "libsvm" and libsvm_max_idx + 1 > ncols:
+        ncols = libsvm_max_idx + 1
+        sample_feats = np.pad(
+            sample_feats, ((0, 0), (0, ncols - sample_feats.shape[1])))
+
+    def shifted(idx):
+        if idx < 0:
+            return -1
+        return idx - 1 if idx > label_idx else idx
+
+    weight_idx = shifted(_parse_column_spec(config.weight_column, names))
+    group_idx = shifted(_parse_column_spec(config.group_column, names))
+    if group_idx >= 0 and sharding:
+        log.fatal("two_round loading cannot shard ranking data by query; "
+                  "use use_two_round_loading=false")
+    ignore = _parse_ignore_set(config, names)
+    drop_cols = {c for c in (weight_idx, group_idx) if c >= 0}
+
+    used_cols = [j for j in range(ncols)
+                 if j not in drop_cols and j not in ignore]
+    mappers_all: List[Optional[BinMapper]] = [None] * ncols
+    if num_shards > 1 and config.is_parallel_find_bin:
+        from .binning import find_bins_distributed
+        dist = find_bins_distributed(sample_feats[:, used_cols],
+                                     sample_feats.shape[0], config.max_bin,
+                                     rank, num_shards)
+        for j, m in zip(used_cols, dist):
+            mappers_all[j] = m
+    else:
+        for j in used_cols:
+            mappers_all[j] = find_bin(sample_feats[:, j],
+                                      sample_feats.shape[0], config.max_bin)
+
+    if not names:
+        names = ["Column_%d" % i for i in range(ncols)]
+
+    for j in ignore:
+        if 0 <= j < ncols and mappers_all[j] is None:
+            log.warning("Ignoring feature %s" % names[j])
+    used_feature_map, bin_mappers, real_index = _select_used_features(
+        mappers_all, names)
+    if not bin_mappers:
+        log.fatal("No usable features in data file %s" % filename)
+
+    # ---- round 2: parse + quantize chunk by chunk ----
+    n_local = (n_total // num_shards
+               + (1 if rank < n_total % num_shards else 0)
+               if sharding else n_total)
+    max_bin_used = max(m.num_bin for m in bin_mappers)
+    dtype = np.uint8 if max_bin_used <= 256 else np.uint16
+    bins = np.zeros((len(bin_mappers), n_local), dtype=dtype)
+    label = np.empty(n_local, dtype=np.float32)
+    weights = np.empty(n_local, dtype=np.float32) if weight_idx >= 0 else None
+    qid = np.empty(n_local, dtype=np.int64) if group_idx >= 0 else None
+    row0 = 0   # global row counter
+    out0 = 0   # local write position
+    with open(filename, "rb") as f:
+        _skip_header(f, config)
+        for chunk in _stream_line_chunks(f):
+            clabel, cfeats, _ = parse_file_bytes(chunk, label_idx, fmt)
+            k = len(clabel)
+            if cfeats.shape[1] < ncols:   # libsvm chunks can be narrower
+                cfeats = np.pad(cfeats,
+                                ((0, 0), (0, ncols - cfeats.shape[1])))
+            elif cfeats.shape[1] > ncols:
+                cfeats = cfeats[:, :ncols]
+            if sharding:
+                sel = (np.arange(row0, row0 + k) % num_shards) == rank
+                clabel, cfeats = clabel[sel], cfeats[sel]
+            kk = len(clabel)
+            label[out0:out0 + kk] = clabel
+            if weights is not None:
+                weights[out0:out0 + kk] = cfeats[:, weight_idx]
+            if qid is not None:
+                qid[out0:out0 + kk] = cfeats[:, group_idx].astype(np.int64)
+            for inner, real in enumerate(real_index):
+                bins[inner, out0:out0 + kk] = (
+                    bin_mappers[inner].value_to_bin(cfeats[:, real])
+                    .astype(dtype))
+            row0 += k
+            out0 += kk
+    assert out0 == n_local, (out0, n_local)
+
+    query_boundaries = None
+    if qid is not None:
+        change = np.nonzero(np.diff(qid))[0] + 1
+        query_boundaries = np.concatenate(
+            [[0], change, [n_local]]).astype(np.int32)
+    w = _load_sidecar(filename + ".weight")
+    if w is not None:
+        weights = w.astype(np.float32)
+        log.info("Loading weights...")
+    q = _load_sidecar(filename + ".query")
+    if q is not None:
+        query_boundaries = np.concatenate(
+            [[0], np.cumsum(q.astype(np.int64))]).astype(np.int32)
+        log.info("Loading query boundaries...")
+    init = _load_sidecar(filename + ".init")
+    if sharding:
+        if q is not None:
+            log.fatal("two_round loading cannot shard ranking data by "
+                      "query; use use_two_round_loading=false")
+        keep = np.arange(n_total) % num_shards == rank
+        if w is not None:
+            weights = weights[keep]
+        if init is not None:
+            if len(init) % n_total:
+                log.warning("Ignoring init score file: %d values do not "
+                            "tile %d rows" % (len(init), n_total))
+                init = None
+            else:
+                kcls = len(init) // n_total
+                init = np.ascontiguousarray(
+                    np.asarray(init).reshape(kcls, n_total)[:, keep]
+                ).reshape(-1)
+
+    metadata = Metadata(label=label, weights=weights,
+                        query_boundaries=query_boundaries, init_score=init)
+    metadata.finish_queries()
+    ds = Dataset(bins=bins, bin_mappers=bin_mappers,
+                 used_feature_map=used_feature_map,
+                 real_feature_index=np.asarray(real_index, dtype=np.int32),
+                 num_total_features=ncols, feature_names=names,
+                 metadata=metadata, label_idx=label_idx)
+    log.info("Finished loading data file, use %d features with %d data"
+             % (ds.num_features, ds.num_data))
+    if config.is_save_binary_file and num_shards == 1:
+        _save_binary(ds, filename + ".bin")
+    return ds
+
+
 def load_dataset(filename: str, config: Config,
                  reference: Optional[Dataset] = None,
                  rank: int = 0, num_shards: int = 1) -> Dataset:
@@ -139,6 +439,9 @@ def load_dataset(filename: str, config: Config,
             return _load_binary(cache)
         except Exception as e:  # corrupt/stale cache: fall through to text
             log.warning("Failed to load binary cache %s: %s" % (cache, e))
+
+    if config.use_two_round_loading and reference is None:
+        return _load_two_round(filename, config, rank, num_shards)
 
     with open(filename, "rb") as f:
         raw = f.read()
@@ -197,15 +500,7 @@ def load_dataset(filename: str, config: Config,
             [[0], change, [n_total]]).astype(np.int32)
         drop_cols.add(group_idx)
 
-    ignore = set()
-    if config.ignore_column:
-        spec = config.ignore_column
-        if spec.startswith("name:"):
-            for nm in spec[5:].split(","):
-                if nm in names:
-                    ignore.add(names.index(nm))
-        else:
-            ignore.update(int(x) for x in spec.split(",") if x.strip())
+    ignore = _parse_ignore_set(config, names)
 
     # sidecar files override/augment (reference metadata.cpp:252-327),
     # loaded full-length BEFORE any row sharding so they stay row-aligned
@@ -297,20 +592,11 @@ def load_dataset(filename: str, config: Config,
             mappers_all[j] = find_bin(sample[:, j], sample.shape[0],
                                       config.max_bin)
 
-    used_feature_map = np.full(ncols, -1, dtype=np.int32)
-    bin_mappers: List[BinMapper] = []
-    real_index: List[int] = []
-    for j, m in enumerate(mappers_all):
-        if m is None:
-            if j in ignore:
-                log.warning("Ignoring feature %s" % names[j])
-            continue
-        if m.is_trivial:
-            log.warning("Ignoring feature %s, only has one value" % names[j])
-            continue
-        used_feature_map[j] = len(bin_mappers)
-        bin_mappers.append(m)
-        real_index.append(j)
+    for j in ignore:
+        if 0 <= j < ncols and mappers_all[j] is None:
+            log.warning("Ignoring feature %s" % names[j])
+    used_feature_map, bin_mappers, real_index = _select_used_features(
+        mappers_all, names)
 
     if not bin_mappers:
         log.fatal("No usable features in data file %s" % filename)
