@@ -150,6 +150,13 @@ def _skip_header(f, config) -> List[str]:
     if not config.has_header:
         return names
     head = f.read(1 << 16)
+    # keep reading until the buffer contains a line break (headers can
+    # exceed one read for very wide files)
+    while (b"\n" not in head and b"\r" not in head):
+        more = f.read(1 << 16)
+        if not more:
+            break
+        head += more
     pos = 0
     first = ""
     for ln in head.splitlines(keepends=True):
@@ -201,14 +208,9 @@ def _select_used_features(mappers_all, names):
 def _scan_libsvm_max_idx(chunk: bytes) -> int:
     """Max feature index in a libsvm chunk (native scan when available)."""
     from .. import native
-    lib = native.get_lib()
-    if lib is not None:
-        import ctypes
-        rows = ctypes.c_int64()
-        mx = ctypes.c_int64()
-        lib.lgt_scan_libsvm(chunk, len(chunk), ctypes.byref(rows),
-                            ctypes.byref(mx))
-        return int(mx.value)
+    scanned = native.scan_libsvm(chunk)
+    if scanned is not None:
+        return scanned[1]
     mx = -1
     for ln in chunk.split(b"\n"):
         for tok in ln.split():
@@ -245,6 +247,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
     n_total = 0
     fmt = None
     libsvm_max_idx = -1
+    first_line = None
     with open(filename, "rb") as f:
         names = _skip_header(f, config)
         for chunk in _stream_line_chunks(f):
@@ -252,6 +255,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
             if not lines:
                 continue
             if fmt is None:
+                first_line = lines[0]
                 fmt = detect_format([ln.decode("utf-8", "replace")
                                      for ln in lines[:2]])
             if fmt == "libsvm":
@@ -291,10 +295,20 @@ def _load_two_round(filename: str, config: Config, rank: int,
     sample_raw = b"\n".join(kept) + b"\n"
     _, sample_feats, fmt = parse_file_bytes(sample_raw, label_idx, fmt)
     ncols = sample_feats.shape[1]
-    if fmt == "libsvm" and libsvm_max_idx + 1 > ncols:
-        ncols = libsvm_max_idx + 1
+    if fmt == "libsvm":
+        # schema width from the whole-file scan, not the sample
+        ncols = max(ncols, libsvm_max_idx + 1)
+    else:
+        # dense width follows the FIRST data line exactly like one-round
+        # loading (native lgt_scan_dense sizes columns from line 1; wider
+        # rows have extra fields ignored, narrower rows zero-fill)
+        _, ffeats, _ = parse_file_bytes(first_line + b"\n", label_idx, fmt)
+        ncols = ffeats.shape[1]
+    if sample_feats.shape[1] < ncols:
         sample_feats = np.pad(
             sample_feats, ((0, 0), (0, ncols - sample_feats.shape[1])))
+    elif sample_feats.shape[1] > ncols:
+        sample_feats = sample_feats[:, :ncols]
 
     def shifted(idx):
         if idx < 0:
@@ -350,6 +364,10 @@ def _load_two_round(filename: str, config: Config, rank: int,
     with open(filename, "rb") as f:
         _skip_header(f, config)
         for chunk in _stream_line_chunks(f):
+            chunk = b"\n".join(
+                ln for ln in chunk.split(b"\n") if ln.strip()) + b"\n"
+            if chunk == b"\n":
+                continue
             clabel, cfeats, _ = parse_file_bytes(chunk, label_idx, fmt)
             k = len(clabel)
             if cfeats.shape[1] < ncols:   # libsvm chunks can be narrower

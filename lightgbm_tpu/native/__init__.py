@@ -221,6 +221,18 @@ def ndcg_eval(score, label, query_boundaries, ks, label_gain, query_weights
     return out
 
 
+def scan_libsvm(text: bytes) -> Optional[Tuple[int, int]]:
+    """(rows, max feature index) of a libsvm buffer, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    max_idx = ctypes.c_int64()
+    lib.lgt_scan_libsvm(text, len(text), ctypes.byref(rows),
+                        ctypes.byref(max_idx))
+    return rows.value, max_idx.value
+
+
 def bin_values(vals: np.ndarray, bounds: np.ndarray
                ) -> Optional[np.ndarray]:
     """Binary-search binning (BinMapper::ValueToBin) -> uint8 bins."""

@@ -1,0 +1,81 @@
+"""Worker for the distributed golden-parity test
+(test_parallel.py::test_multihost_matches_reference_socket_cluster).
+
+Mirrors ONE machine of the reference's 2-machine socket data-parallel
+run (examples/parallel_learning with tree_learner=data,
+is_pre_partition pre-split): loads its modulo row shard of binary.train,
+runs distributed bin finding over the 2-process allgather, trains with
+the bagging/feature_fraction RNG streams, prints metric lines in the
+reference log format, and saves the model.
+
+Usage: python mh_parity_worker.py <rank> <nproc> <port> <out_model> <out_log>
+"""
+
+import os
+import sys
+
+rank, nproc, port, out_model, out_log = (int(sys.argv[1]), int(sys.argv[2]),
+                                         sys.argv[3], sys.argv[4],
+                                         sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.metrics import create_metrics  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+EX = os.environ.get("LGT_REFERENCE_DIR",
+                    "/root/reference") + "/examples/binary_classification"
+ITERS = 4
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "data",
+    "metric": "binary_logloss,auc", "is_training_metric": "true",
+    "max_bin": "255", "num_leaves": "63", "learning_rate": "0.1",
+    "feature_fraction": "0.8", "bagging_freq": "5",
+    "bagging_fraction": "0.8", "min_data_in_leaf": "50",
+    "min_sum_hessian_in_leaf": "5.0", "hist_dtype": "float64",
+    "is_save_binary_file": "false",
+    "enable_load_from_binary_file": "false"})
+train = load_dataset(os.path.join(EX, "binary.train"), cfg,
+                     rank=rank, num_shards=nproc)
+valid = load_dataset(os.path.join(EX, "binary.test"), cfg, reference=train)
+obj = create_objective(cfg)
+obj.init(train.metadata, train.num_data)
+tms = []
+for m in create_metrics(cfg):
+    m.init("training", train.metadata, train.num_data)
+    tms.append(m)
+vms = []
+for m in create_metrics(cfg):
+    m.init("binary.test", valid.metadata, valid.num_data)
+    vms.append(m)
+booster = create_boosting(cfg, train, obj, tms)
+booster.add_valid_data(valid, vms)
+
+lines = []
+for it in range(ITERS):
+    booster.train_one_iter(None, None, False)
+    tscore = np.asarray(booster._training_score())
+    for m in tms:
+        for nm, v in zip(m.names, m.eval(tscore)):
+            lines.append("Iteration: %d, %s : %f" % (it + 1, nm.strip(), v))
+    vs = np.asarray(booster.valid_scores[0])[0]
+    for m in vms:
+        for nm, v in zip(m.names, m.eval(vs)):
+            lines.append("Iteration: %d, %s : %f" % (it + 1, nm.strip(), v))
+booster.save_model_to_file(-1, True, out_model)
+with open(out_log, "w") as f:
+    f.write("\n".join(lines) + "\n")
+print("parity worker %d done" % rank)

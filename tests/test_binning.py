@@ -76,9 +76,11 @@ class TestTwoRoundLoading:
         return Config.from_params(p)
 
     def test_matches_one_round_on_example(self):
+        import os
+        from conftest import REFERENCE_DIR
         from lightgbm_tpu.io.dataset import load_dataset
-        import lightgbm_tpu.io.dataset as dsmod
-        path = "/root/reference/examples/binary_classification/binary.train"
+        path = os.path.join(REFERENCE_DIR,
+                            "examples/binary_classification/binary.train")
         one = load_dataset(path, self._cfg())
         two = load_dataset(path, self._cfg({"use_two_round_loading": "true"}))
         np.testing.assert_array_equal(one.bins, two.bins)
