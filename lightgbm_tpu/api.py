@@ -405,6 +405,17 @@ class Booster:
         return out[0] if out.shape[0] == 1 else out.T
 
     # -- model io (LGBM_BoosterSaveModel / LoadModelFromString) ---------
+    def save_checkpoint(self, path: str) -> None:
+        """Exact-state trainer snapshot (model + scores + RNG streams);
+        load_checkpoint resumes training bit-for-bit.  Superset of the
+        reference, whose resume re-boosts from predicted init scores."""
+        self._gbdt.save_checkpoint(path)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a save_checkpoint snapshot into a Booster built with
+        the same params and datasets."""
+        self._gbdt.load_checkpoint(path)
+
     def save_model(self, path: str, num_iteration: int = -1) -> None:
         # the GBDT save path is incremental (per-iteration append,
         # gbdt.cpp:351-400); reset its cursor for a standalone full save

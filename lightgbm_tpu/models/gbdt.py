@@ -667,6 +667,76 @@ class GBDT:
         out += ["%s=%d" % (name, cnt) for cnt, name in pairs]
         return "\n".join(out) + "\n"
 
+    # -- exact-state checkpointing (superset of the reference, whose only
+    # resume path re-boosts from predicted init scores and restarts the
+    # bagging/feature RNG streams — SURVEY.md §5 checkpoint/resume) -----
+    _TREE_FIELDS = ("split_feature", "split_feature_real", "threshold_bin",
+                    "threshold", "split_gain", "left_child", "right_child",
+                    "internal_value", "leaf_parent", "leaf_value",
+                    "leaf_depth", "leaf_count")
+
+    def save_checkpoint(self, path: str) -> None:
+        """Snapshot the FULL trainer state: exact tree arrays (NOT the
+        lossy 6-digit text format), score vectors, bagging masks,
+        early-stopping bookkeeping and mt19937 stream positions.
+        Resuming from it continues training bit-for-bit."""
+        self._flush_pending()
+        arrays = {
+            "iter": np.int64(self.iter),
+            "num_used_model": np.int64(self.num_used_model),
+            "stopped": np.int64(self._stopped),
+            "scores": np.asarray(self.scores),
+            "bag_masks": np.stack(self.bag_masks),
+            "best_iter": np.asarray(self.best_iter, dtype=np.int64),
+            "best_score": np.asarray(self.best_score, dtype=np.float64),
+            "num_trees": np.int64(len(self._models)),
+        }
+        for t, tree in enumerate(self._models):
+            arrays["tree%d_num_leaves" % t] = np.int64(tree.num_leaves)
+            for f in self._TREE_FIELDS:
+                arrays["tree%d_%s" % (t, f)] = np.asarray(getattr(tree, f))
+        for i, vs in enumerate(self.valid_scores):
+            arrays["valid_scores_%d" % i] = np.asarray(vs)
+        for name, rng in self._rng_streams():
+            arrays[name] = rng.get_state()
+        with open(path, "wb") as f:   # keep the exact path (savez would
+            np.savez(f, **arrays)     # append .npz to a bare name)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a save_checkpoint snapshot into a booster built with
+        the same config and datasets."""
+        z = np.load(path)
+        self.iter = int(z["iter"])
+        self._stopped = bool(z["stopped"])
+        self.scores = jnp.asarray(z["scores"])
+        if self.grower is not None and self.rows_sharded and not self._mh:
+            self.scores = jax.device_put(self.scores,
+                                         self.grower.row_sharding_2d())
+        self.bag_masks = [m.copy() for m in z["bag_masks"]]
+        self._bag_dev = [None] * self.num_class
+        self.best_iter = [list(r) for r in z["best_iter"]]
+        self.best_score = [list(r) for r in z["best_score"]]
+        for i in range(len(self.valid_scores)):
+            self.valid_scores[i] = jnp.asarray(z["valid_scores_%d" % i])
+        for name, rng in self._rng_streams():
+            rng.set_state(z[name])
+        self._models = []
+        for t in range(int(z["num_trees"])):
+            fields = {f: z["tree%d_%s" % (t, f)].copy()
+                      for f in self._TREE_FIELDS}
+            self._models.append(Tree(
+                num_leaves=int(z["tree%d_num_leaves" % t]), **fields))
+        # honor a SetNumUsedModel cap active at checkpoint time
+        self.num_used_model = min(int(z["num_used_model"]),
+                                  len(self._models) // self.num_class)
+
+    def _rng_streams(self):
+        out = [("bag_rng", self.bag_rng)]
+        out += [("feat_rng_%d" % i, r) for i, r in enumerate(self.feat_rngs)]
+        if hasattr(self, "drop_rng"):
+            out.append(("drop_rng", self.drop_rng))
+        return out
+
     def load_model_from_string(self, model_str: str) -> None:
         """GBDT::LoadModelFromString (gbdt.cpp:402-456)."""
         lines = model_str.splitlines()

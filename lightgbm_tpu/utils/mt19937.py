@@ -79,6 +79,21 @@ class Mt19937Random:
         self._pos += count
         return res
 
+    def get_state(self) -> np.ndarray:
+        """Serializable stream state: generator state + undrawn buffer
+        (checkpointing; see GBDT.save_checkpoint)."""
+        return np.concatenate([
+            np.asarray([len(self._state)], dtype=np.uint32),
+            self._state.astype(np.uint32),
+            self._buf[self._pos:].astype(np.uint32)])
+
+    def set_state(self, packed: np.ndarray) -> None:
+        packed = np.asarray(packed, dtype=np.uint32)
+        n = int(packed[0])
+        self._state = packed[1:1 + n].copy()
+        self._buf = packed[1 + n:].copy()
+        self._pos = 0
+
     def next_doubles(self, count: int) -> np.ndarray:
         """count draws of uniform_real_distribution<double>(0,1): 2 raws each."""
         raw = self._raw(2 * count).astype(np.float64)

@@ -234,3 +234,52 @@ def test_subtract_tree_scores_rolls_back_exactly():
     # and it actually changed something
     assert not np.allclose(before_train, after_train)
     assert not np.allclose(before_valid, after_valid)
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "dart"])
+def test_exact_state_checkpoint_resume(tmp_path, boosting):
+    """save_checkpoint/load_checkpoint: resuming mid-training reproduces
+    uninterrupted training bit-for-bit, INCLUDING the bagging and
+    feature_fraction mt19937 stream positions (the reference's only
+    resume path restarts those)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(800, 6)
+    y = (x[:, 0] + 0.4 * x[:, 1] > 0).astype(np.float64)
+    xv = rng.randn(300, 6)
+    yv = (xv[:, 0] + 0.4 * xv[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 8,
+              "min_data_in_leaf": 5, "metric": "binary_logloss",
+              "bagging_fraction": 0.7, "bagging_freq": 2,
+              "feature_fraction": 0.8, "learning_rate": 0.2,
+              "boosting_type": boosting}
+
+    def mk():
+        ds = lgb.Dataset(x, label=y)
+        vs = lgb.Dataset(xv, label=yv, reference=ds)
+        bst = lgb.Booster(params, ds)
+        bst.add_valid(vs, "v0")
+        return bst
+
+    # uninterrupted 10 iterations
+    a = mk()
+    for _ in range(10):
+        a.update()
+    a_model = a.model_to_string()
+    a_eval = a._gbdt.get_eval_at(1)
+
+    # 5 iterations -> checkpoint -> fresh booster -> resume -> 5 more
+    b = mk()
+    for _ in range(5):
+        b.update()
+    ckpt = str(tmp_path / "state.npz")
+    b._gbdt.save_checkpoint(ckpt)
+    c = mk()
+    c._gbdt.load_checkpoint(ckpt)
+    assert c.current_iteration == 5
+    for _ in range(5):
+        c.update()
+    assert c.model_to_string() == a_model
+    np.testing.assert_array_equal(np.asarray(c._gbdt.get_eval_at(1)),
+                                  np.asarray(a_eval))
