@@ -306,26 +306,35 @@ class GBDT:
         """One boosting iteration (gbdt.cpp:169-205). Returns True when
         training must stop."""
         cfg = self.config
-        if gradients is None or hessians is None:
-            grad, hess = self.objective.get_gradients(self._score_for_gradients())
-            if grad.ndim == 1:
-                grad = grad[None, :]
-                hess = hess[None, :]
+        if gradients is None and self._can_fuse():
+            # fully-fused iteration: gradients -> grow -> score updates ->
+            # tree packing in ONE dispatch with donated score buffers
+            self._bagging(self.iter, 0)
+            fmask = self._feature_mask(0)
+            self._models.append(self._run_fused(
+                self._bag_mask_dev(0), jnp.asarray(fmask)))
         else:
-            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
-                self.num_class, self.num_data)
-            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
-                self.num_class, self.num_data)
-            if self.n_pad != self.num_data:
-                pad = ((0, 0), (0, self.n_pad - self.num_data))
-                grad = jnp.pad(grad, pad)
-                hess = jnp.pad(hess, pad)
-
-        for cls in range(self.num_class):
-            self._bagging(self.iter, cls)
-            fmask = self._feature_mask(cls)
-            self._models.append(self._train_tree(
-                grad[cls], hess[cls], self._bag_mask_dev(cls), fmask, cls))
+            if gradients is None or hessians is None:
+                grad, hess = self.objective.get_gradients(
+                    self._score_for_gradients())
+                if grad.ndim == 1:
+                    grad = grad[None, :]
+                    hess = hess[None, :]
+            else:
+                grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                    self.num_class, self.num_data)
+                hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                    self.num_class, self.num_data)
+                if self.n_pad != self.num_data:
+                    pad = ((0, 0), (0, self.n_pad - self.num_data))
+                    grad = jnp.pad(grad, pad)
+                    hess = jnp.pad(hess, pad)
+            for cls in range(self.num_class):
+                self._bagging(self.iter, cls)
+                fmask = self._feature_mask(cls)
+                self._models.append(self._train_tree(
+                    grad[cls], hess[cls], self._bag_mask_dev(cls), fmask,
+                    cls))
         self.iter += 1
         self.num_used_model = len(self._models) // self.num_class
         custom_grads = gradients is not None
@@ -347,6 +356,63 @@ class GBDT:
             else:
                 self._bag_dev[cls] = jnp.asarray(mask)
         return self._bag_dev[cls]
+
+    def _can_fuse(self) -> bool:
+        """The fused single-dispatch iteration covers the serial single-
+        class path with a jax-traceable objective (regression/binary);
+        DART (per-iteration score surgery + varying shrinkage), custom
+        gradients, multiclass, and sharded growers take the general
+        path."""
+        return (type(self) is GBDT and self.num_class == 1
+                and self.grower is None
+                and getattr(self.objective, "jax_traceable", False))
+
+    def _run_fused(self, bag_mask_dev, fmask_dev) -> "_PendingTree":
+        if not hasattr(self, "_fused_fn"):
+            cfg = self.config
+            obj = self.objective
+            bins_dev = self.bins_dev
+            dtype = self.dtype
+            lr = self.shrinkage_rate
+            valid_bins = list(self.valid_bins_dev)
+            grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
+                           max_bin=self.max_bin, params=self.params,
+                           max_depth=cfg.max_depth,
+                           hist_impl=self.hist_impl)
+
+            def step(scores, valid_scores, bag_mask, fmask):
+                grad, hess = obj.get_gradients(scores[0])
+                dev_tree, leaf_id = grow_tree(
+                    bins_dev, grad.astype(dtype), hess.astype(dtype),
+                    bag_mask, fmask, **grow_kw)
+                leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
+                scores = scores.at[0].add(leaf_vals[leaf_id])
+                new_valid = []
+                for vs, vbins in zip(valid_scores, valid_bins):
+                    vleaf = predict_leaf_binned(
+                        dev_tree.split_feature, dev_tree.threshold_bin,
+                        dev_tree.left_child, dev_tree.right_child, vbins)
+                    new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
+                ints, floats = _pack_tree(dev_tree)
+                return scores, new_valid, ints, floats
+
+            self._fused_fn = jax.jit(step, donate_argnums=(0, 1))
+            self._fused_lr = lr
+        # the jitted step froze the learning rate at build time; a live
+        # shrinkage_rate change (DART-style) would silently desync scores
+        # from the unpacked trees, so the fused path refuses it
+        assert self._fused_lr == self.shrinkage_rate, \
+            "shrinkage_rate changed mid-training; fused path is stale"
+        scores, valid, ints, floats = self._fused_fn(
+            self.scores, list(self.valid_scores), bag_mask_dev, fmask_dev)
+        self.scores = scores
+        self.valid_scores = list(valid)
+        for a in (ints, floats):
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+        return _PendingTree(ints, floats, self._fused_lr)
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config

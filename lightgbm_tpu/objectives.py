@@ -32,6 +32,9 @@ K_MIN_SCORE = -np.inf
 
 
 class Objective:
+    # True when get_gradients is pure jax over captured device arrays and
+    # may be traced inside a fused training step (models/gbdt.py)
+    jax_traceable = False
     name = "none"
     num_class = 1
 
@@ -64,6 +67,7 @@ class Objective:
 
 class RegressionL2(Objective):
     name = "regression"
+    jax_traceable = True
 
     def __init__(self, config: Config):
         pass
@@ -91,6 +95,7 @@ class RegressionL2(Objective):
 
 class BinaryLogloss(Objective):
     name = "binary"
+    jax_traceable = True
 
     def __init__(self, config: Config):
         self.sigmoid = np.float32(config.sigmoid)
@@ -143,6 +148,10 @@ class BinaryLogloss(Objective):
 
 class MulticlassSoftmax(Objective):
     name = "multiclass"
+    # NOTE: traceable math, but the fused step (gbdt._can_fuse) also
+    # requires num_class == 1 — multiclass gradients are [K, N] while the
+    # fused step feeds scores[0]; it always takes the general path
+    jax_traceable = True
 
     def __init__(self, config: Config):
         self.num_class = config.num_class
