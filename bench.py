@@ -37,7 +37,7 @@ CACHE = os.path.join(REPO, ".bench_cache")
 REF_SRC = "/root/reference"
 REF_BUILD = os.path.join(REPO, ".ref_build")
 
-N_ROWS = 1_000_000
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEAT = 28
 NUM_TREES = 100
 NUM_LEAVES = 63
