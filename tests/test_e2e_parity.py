@@ -108,7 +108,10 @@ def check_model_trees(booster, golden_name, num_trees, rtol=5e-6):
         for key in ("split_gain", "leaf_value", "internal_value"):
             a = np.array(ours[key].split(), dtype=np.float64)
             b = np.array(want[key].split(), dtype=np.float64)
-            np.testing.assert_allclose(a, b, rtol=rtol,
+            # atol covers 6-significant-digit print rounding of near-zero
+            # values (e.g. leaf_value 1e-6-scale), where rtol alone flags
+            # a last-printed-digit flip
+            np.testing.assert_allclose(a, b, rtol=rtol, atol=1e-8,
                                        err_msg="tree %d %s" % (i, key))
 
 
