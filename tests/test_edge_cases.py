@@ -1,0 +1,95 @@
+"""Robustness sweep: degenerate shapes and extreme configs must train
+without crashing (the reference has no tests at all here; these pin the
+padding, trivial-feature, dummy-slot and regularization edge paths)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, x, y, rounds=3, weight=None):
+    ds = lgb.Dataset(x, label=y)
+    if weight is not None:
+        ds.set_weight(weight)
+    p = {"min_data_in_leaf": 1, "metric": ""}
+    p.update(params)
+    return lgb.train(p, ds, num_boost_round=rounds, verbose_eval=False)
+
+
+@pytest.fixture
+def rng():
+    """Fresh stream per test so data does not depend on execution order."""
+    return np.random.RandomState(0)
+
+
+def test_single_feature(rng):
+    bst = _train({"objective": "regression", "num_leaves": 4},
+                 rng.randn(50, 1), rng.randn(50))
+    assert bst.predict(rng.randn(10, 1)).shape == (10,)
+
+
+def test_num_leaves_2_stumps(rng):
+    bst = _train({"objective": "binary", "num_leaves": 2},
+                 rng.randn(60, 3), (rng.rand(60) > 0.5).astype(float))
+    for t in bst._gbdt.models:
+        assert t.num_leaves == 2
+
+
+def test_tiny_dataset(rng):
+    _train({"objective": "regression", "num_leaves": 4},
+           rng.randn(8, 2), rng.randn(8), rounds=2)
+
+
+def test_constant_feature_dropped(rng):
+    x = rng.randn(100, 3)
+    x[:, 1] = 7.0
+    bst = _train({"objective": "regression", "num_leaves": 4},
+                 x, rng.randn(100), rounds=2)
+    assert bst._gbdt.train_data.num_features == 2
+
+
+def test_max_bin_2(rng):
+    _train({"objective": "binary", "num_leaves": 4, "max_bin": 2},
+           rng.randn(100, 4), (rng.rand(100) > 0.5).astype(float))
+
+
+def test_heavy_regularization(rng):
+    bst = _train({"objective": "regression", "num_leaves": 8,
+                  "lambda_l1": 5.0, "lambda_l2": 10.0},
+                 rng.randn(200, 4), rng.randn(200))
+    # L1 at this strength clamps most leaf outputs toward zero
+    for t in bst._gbdt.models:
+        assert np.all(np.abs(t.leaf_value) < 1.0)
+
+
+def test_max_depth_limits_leaves(rng):
+    bst = _train({"objective": "binary", "num_leaves": 32, "max_depth": 2},
+                 rng.randn(300, 5), (rng.rand(300) > 0.5).astype(float))
+    for t in bst._gbdt.models:
+        assert t.num_leaves <= 4          # depth 2 => at most 4 leaves
+        assert np.all(t.leaf_depth[:t.num_leaves] <= 2)
+
+
+def test_mostly_zero_weights(rng):
+    w = np.zeros(200)
+    w[:10] = 1.0
+    _train({"objective": "regression", "num_leaves": 4},
+           rng.randn(200, 3), rng.randn(200), rounds=2, weight=w)
+
+
+def test_data_parallel_tiny_shards(rng):
+    _train({"objective": "binary", "tree_learner": "data", "num_shards": 8,
+            "num_leaves": 4},
+           rng.randn(64, 3), (rng.rand(64) > 0.5).astype(float), rounds=2)
+
+
+def test_multiclass_two_classes(rng):
+    bst = _train({"objective": "multiclass", "num_class": 2,
+                  "metric": "multi_logloss", "num_leaves": 4},
+                 rng.randn(150, 3), rng.randint(0, 2, 150).astype(float))
+    p = bst.predict(rng.randn(20, 3))
+    assert p.shape == (2, 20) or p.shape == (20, 2)
+    np.testing.assert_allclose(np.asarray(p).reshape(2, -1).sum(axis=0)
+                               if p.shape[0] == 2 else p.sum(axis=1),
+                               1.0, rtol=1e-5)
