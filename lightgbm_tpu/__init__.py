@@ -14,7 +14,10 @@ Public surface:
     predict/save).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+from .utils.compile_cache import enable_compilation_cache
+enable_compilation_cache()
 
 from .config import Config                      # noqa: F401
 from .io.dataset import load_dataset            # noqa: F401

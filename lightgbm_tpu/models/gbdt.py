@@ -15,6 +15,7 @@ parity tests with bagging enabled.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import List, Optional, Sequence
 
 import jax
@@ -53,7 +54,7 @@ def _pack_tree(dev_tree):
     """TreeArrays -> (int32 buffer, float buffer): two flat arrays so a
     whole tree ships device->host in two async copies instead of eleven.
     The trailing dummy slots (grow.py TreeArrays) are trimmed here, so the
-    wire layout stays [1 + 5*(L-1) + 3*L | (L-1) + L + (L-1)]."""
+    wire layout stays [1 + 4*(L-1) + 3*L | (L-1) + L + (L-1)]."""
     ints = jnp.concatenate([
         dev_tree.num_leaves.reshape(1), dev_tree.split_feature[:-1],
         dev_tree.threshold_bin[:-1], dev_tree.left_child[:-1],
@@ -64,6 +65,39 @@ def _pack_tree(dev_tree):
                               dev_tree.leaf_value[:-1],
                               dev_tree.internal_value[:-1]])
     return ints, floats
+
+
+# Shared fused-iteration executables, keyed by everything static that
+# shapes the computation (objective fused_key, lr, dtype, grow params,
+# valid-set count).  Bins, labels and scores are jit ARGUMENTS, so the
+# executable embeds no dataset constants: it stays small (MBs, not 100s
+# of MBs), the persistent compilation cache entry is shape-keyed and
+# reusable across processes, and a warm-up booster and the real booster
+# share one compilation.  LRU-bounded so a long hyper-parameter sweep
+# doesn't accumulate executables forever (evicted entries recompile via
+# the persistent disk cache, which is cheap).
+_FUSED_STEPS = OrderedDict()
+_FUSED_STEPS_MAX = 8
+
+
+def _make_fused_step(grad_fn, grow_kw, lr, dtype):
+    def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
+             gstate):
+        grad, hess = grad_fn(scores[0], gstate)
+        dev_tree, leaf_id = grow_tree(
+            bins, grad.astype(dtype), hess.astype(dtype),
+            bag_mask, fmask, **grow_kw)
+        leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
+        scores = scores.at[0].add(leaf_vals[leaf_id])
+        new_valid = []
+        for vs, vbins in zip(valid_scores, valid_bins):
+            vleaf = predict_leaf_binned(
+                dev_tree.split_feature, dev_tree.threshold_bin,
+                dev_tree.left_child, dev_tree.right_child, vbins)
+            new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
+        ints, floats = _pack_tree(dev_tree)
+        return scores, new_valid, ints, floats
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 class GBDT:
@@ -365,46 +399,32 @@ class GBDT:
         path."""
         return (type(self) is GBDT and self.num_class == 1
                 and self.grower is None
-                and getattr(self.objective, "jax_traceable", False))
+                and getattr(self.objective, "jax_traceable", False)
+                and self.objective.fused_key() is not None)
 
     def _run_fused(self, bag_mask_dev, fmask_dev) -> "_PendingTree":
-        if not hasattr(self, "_fused_fn"):
-            cfg = self.config
-            obj = self.objective
-            bins_dev = self.bins_dev
-            dtype = self.dtype
-            lr = self.shrinkage_rate
-            valid_bins = list(self.valid_bins_dev)
+        cfg = self.config
+        lr = self.shrinkage_rate
+        key = (self.objective.fused_key(), lr, self.dtype,
+               self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
+               cfg.max_depth, self.params, len(self.valid_bins_dev))
+        fn = _FUSED_STEPS.get(key)
+        if fn is None:
             grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
                            max_bin=self.max_bin, params=self.params,
                            max_depth=cfg.max_depth,
                            hist_impl=self.hist_impl)
-
-            def step(scores, valid_scores, bag_mask, fmask):
-                grad, hess = obj.get_gradients(scores[0])
-                dev_tree, leaf_id = grow_tree(
-                    bins_dev, grad.astype(dtype), hess.astype(dtype),
-                    bag_mask, fmask, **grow_kw)
-                leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
-                scores = scores.at[0].add(leaf_vals[leaf_id])
-                new_valid = []
-                for vs, vbins in zip(valid_scores, valid_bins):
-                    vleaf = predict_leaf_binned(
-                        dev_tree.split_feature, dev_tree.threshold_bin,
-                        dev_tree.left_child, dev_tree.right_child, vbins)
-                    new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
-                ints, floats = _pack_tree(dev_tree)
-                return scores, new_valid, ints, floats
-
-            self._fused_fn = jax.jit(step, donate_argnums=(0, 1))
-            self._fused_lr = lr
-        # the jitted step froze the learning rate at build time; a live
-        # shrinkage_rate change (DART-style) would silently desync scores
-        # from the unpacked trees, so the fused path refuses it
-        assert self._fused_lr == self.shrinkage_rate, \
-            "shrinkage_rate changed mid-training; fused path is stale"
-        scores, valid, ints, floats = self._fused_fn(
-            self.scores, list(self.valid_scores), bag_mask_dev, fmask_dev)
+            fn = _make_fused_step(self.objective.make_grad_fn(), grow_kw,
+                                  lr, self.dtype)
+            _FUSED_STEPS[key] = fn
+            if len(_FUSED_STEPS) > _FUSED_STEPS_MAX:
+                _FUSED_STEPS.popitem(last=False)
+        else:
+            _FUSED_STEPS.move_to_end(key)
+        scores, valid, ints, floats = fn(
+            self.scores, list(self.valid_scores), bag_mask_dev, fmask_dev,
+            self.bins_dev, tuple(self.valid_bins_dev),
+            self.objective.grad_state())
         self.scores = scores
         self.valid_scores = list(valid)
         for a in (ints, floats):
@@ -412,7 +432,7 @@ class GBDT:
                 a.copy_to_host_async()
             except AttributeError:
                 pass
-        return _PendingTree(ints, floats, self._fused_lr)
+        return _PendingTree(ints, floats, lr)
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
@@ -720,7 +740,10 @@ class GBDT:
             self._model_file = None
 
     def feature_importance(self) -> str:
-        """Split-count importances (gbdt.cpp:458-485)."""
+        """Split-count importances (gbdt.cpp:458-485).  The reference
+        orders ties among equal counts by non-stable std::sort; the native
+        helper reruns that exact sort so the footer is byte-identical
+        (falls back to a stable sort without the toolchain)."""
         imp = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
         for tree in self.models:
             for s in tree.split_feature_real[:tree.num_leaves - 1]:
@@ -728,7 +751,12 @@ class GBDT:
         names = (self.train_data.feature_names if self.train_data is not None
                  else ["Column_%d" % i for i in range(len(imp))])
         pairs = [(imp[i], names[i]) for i in range(len(imp)) if imp[i] > 0]
-        pairs.sort(key=lambda p: -p[0])
+        from .. import native
+        perm = native.sort_importance(np.asarray([p[0] for p in pairs]))
+        if perm is not None:
+            pairs = [pairs[i] for i in perm]
+        else:
+            pairs.sort(key=lambda p: -p[0])
         out = ["", "feature importances:"]
         out += ["%s=%d" % (name, cnt) for cnt, name in pairs]
         return "\n".join(out) + "\n"
@@ -753,10 +781,16 @@ class GBDT:
             "stopped": np.int64(self._stopped),
             "scores": np.asarray(self.scores),
             "bag_masks": np.stack(self.bag_masks),
-            "best_iter": np.asarray(self.best_iter, dtype=np.int64),
-            "best_score": np.asarray(self.best_score, dtype=np.float64),
+            "num_valid_sets": np.int64(len(self.best_iter)),
             "num_trees": np.int64(len(self._models)),
         }
+        # per-valid-set keys: metric counts can differ between valid sets,
+        # so one rectangular [sets, metrics] array would be ragged
+        for i in range(len(self.best_iter)):
+            arrays["best_iter_%d" % i] = np.asarray(self.best_iter[i],
+                                                    dtype=np.int64)
+            arrays["best_score_%d" % i] = np.asarray(self.best_score[i],
+                                                     dtype=np.float64)
         for t, tree in enumerate(self._models):
             arrays["tree%d_num_leaves" % t] = np.int64(tree.num_leaves)
             for f in self._TREE_FIELDS:
@@ -780,8 +814,15 @@ class GBDT:
                                          self.grower.row_sharding_2d())
         self.bag_masks = [m.copy() for m in z["bag_masks"]]
         self._bag_dev = [None] * self.num_class
-        self.best_iter = [list(r) for r in z["best_iter"]]
-        self.best_score = [list(r) for r in z["best_score"]]
+        if "num_valid_sets" in z:
+            nv = int(z["num_valid_sets"])
+            self.best_iter = [[int(v) for v in z["best_iter_%d" % i]]
+                              for i in range(nv)]
+            self.best_score = [[float(v) for v in z["best_score_%d" % i]]
+                               for i in range(nv)]
+        else:   # 0.1.0 checkpoints: one rectangular [sets, metrics] array
+            self.best_iter = [list(map(int, r)) for r in z["best_iter"]]
+            self.best_score = [list(map(float, r)) for r in z["best_score"]]
         for i in range(len(self.valid_scores)):
             self.valid_scores[i] = jnp.asarray(z["valid_scores_%d" % i])
         for name, rng in self._rng_streams():

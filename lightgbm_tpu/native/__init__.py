@@ -97,6 +97,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_parse_libsvm.restype = i64
     lib.lgt_bin_values.argtypes = [pd, i64, pd, ctypes.c_int32, pu8]
     lib.lgt_bin_values.restype = None
+    lib.lgt_sort_importance.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), i64, ctypes.POINTER(ctypes.c_int32)]
+    lib.lgt_sort_importance.restype = None
     pf = ctypes.POINTER(ctypes.c_float)
     pi32 = ctypes.POINTER(ctypes.c_int32)
     lib.lgt_lambdarank_grads.argtypes = [
@@ -231,6 +234,22 @@ def scan_libsvm(text: bytes) -> Optional[Tuple[int, int]]:
     lib.lgt_scan_libsvm(text, len(text), ctypes.byref(rows),
                         ctypes.byref(max_idx))
     return rows.value, max_idx.value
+
+
+def sort_importance(counts: np.ndarray) -> Optional[np.ndarray]:
+    """std::sort permutation of importance counts, descending by count
+    with the reference's introsort tie order (gbdt.cpp:466-477); None
+    when the native library is unavailable (callers fall back to a
+    stable sort, which can differ on ties among >16 entries)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    counts = np.ascontiguousarray(counts, dtype=np.uint64)
+    perm = np.empty(len(counts), dtype=np.int32)
+    lib.lgt_sort_importance(
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(counts),
+        perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return perm
 
 
 def bin_values(vals: np.ndarray, bounds: np.ndarray

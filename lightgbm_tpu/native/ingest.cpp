@@ -356,6 +356,26 @@ void lgt_ndcg_eval(const float* score, const float* label, const int32_t* qb,
   }
 }
 
+// Feature-importance ordering: the reference sorts (count, name) pairs
+// with non-stable std::sort comparing ONLY the count
+// (src/boosting/gbdt.cpp:466-477), so the order among equal counts is
+// whatever libstdc++ introsort leaves.  Running the same std::sort (same
+// comparator, same libstdc++) over (count, position) pairs reproduces the
+// permutation exactly: every control-flow decision in introsort is a
+// comparator call, and the comparator never reads .second.
+void lgt_sort_importance(const uint64_t* counts, int64_t n, int32_t* perm) {
+  std::vector<std::pair<size_t, size_t>> pairs(n);
+  for (int64_t i = 0; i < n; ++i)
+    pairs[i] = {static_cast<size_t>(counts[i]), static_cast<size_t>(i)};
+  std::sort(pairs.begin(), pairs.end(),
+            [](const std::pair<size_t, size_t>& lhs,
+               const std::pair<size_t, size_t>& rhs) {
+              return lhs.first > rhs.first;
+            });
+  for (int64_t i = 0; i < n; ++i)
+    perm[i] = static_cast<int32_t>(pairs[i].second);
+}
+
 // value -> bin: upper-bound binary search over bin_upper_bound, exactly
 // BinMapper::ValueToBin (reference include/LightGBM/bin.h:296-309).
 void lgt_bin_values(const double* vals, int64_t n, const double* bounds,

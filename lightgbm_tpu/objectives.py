@@ -60,6 +60,26 @@ class Objective:
     def get_gradients(self, score):
         raise NotImplementedError
 
+    # -- fused-step surface (models/gbdt.py) ---------------------------
+    # The fused training step passes label-derived arrays as jit
+    # ARGUMENTS (grad_state) to a pure gradient function (make_grad_fn),
+    # so the compiled executable carries no embedded label constants and
+    # one executable is shared by every booster whose fused_key matches.
+    def fused_key(self):
+        """Hashable key fully identifying the gradient computation, or
+        None when this objective cannot be traced in the fused step."""
+        return None
+
+    def grad_state(self):
+        """Pytree of device arrays consumed by make_grad_fn's function."""
+        raise NotImplementedError
+
+    def make_grad_fn(self):
+        """-> pure fn (score, grad_state) -> (grad, hess).  Two
+        objectives with equal fused_key must return functions that trace
+        identically."""
+        raise NotImplementedError
+
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         """Final transform for human-facing predictions."""
         return score
@@ -84,13 +104,26 @@ class RegressionL2(Objective):
         self.weights = self._pad(self.weights, n_pad)
 
     def get_gradients(self, score):
-        score = score.astype(jnp.float32)
-        grad = score - self.label
-        hess = jnp.ones_like(grad)
-        if self.weights is not None:
-            grad = grad * self.weights
-            hess = self.weights
-        return grad, hess
+        return self.make_grad_fn()(score, self.grad_state())
+
+    def fused_key(self):
+        return ("regression", self.weights is not None)
+
+    def grad_state(self):
+        return (self.label, self.weights)
+
+    @staticmethod
+    def make_grad_fn():
+        def grad_fn(score, state):
+            label, weights = state
+            score = score.astype(jnp.float32)
+            grad = score - label
+            hess = jnp.ones_like(grad)
+            if weights is not None:
+                grad = grad * weights
+                hess = weights
+            return grad, hess
+        return grad_fn
 
 
 class BinaryLogloss(Objective):
@@ -133,14 +166,27 @@ class BinaryLogloss(Objective):
         self.label_weight = self._pad(self.label_weight, n_pad)
 
     def get_gradients(self, score):
-        score = score.astype(jnp.float32)
+        return self.make_grad_fn()(score, self.grad_state())
+
+    def fused_key(self):
+        return ("binary", float(self.sigmoid))
+
+    def grad_state(self):
+        return (self.sign, self.label_weight)
+
+    def make_grad_fn(self):
         sig = jnp.float32(self.sigmoid)
-        response = (-2.0 * self.sign * sig
-                    / (1.0 + jnp.exp(2.0 * self.sign * sig * score)))
-        abs_r = jnp.abs(response)
-        grad = response * self.label_weight
-        hess = abs_r * (2.0 * sig - abs_r) * self.label_weight
-        return grad, hess
+
+        def grad_fn(score, state):
+            sign, label_weight = state
+            score = score.astype(jnp.float32)
+            response = (-2.0 * sign * sig
+                        / (1.0 + jnp.exp(2.0 * sign * sig * score)))
+            abs_r = jnp.abs(response)
+            grad = response * label_weight
+            hess = abs_r * (2.0 * sig - abs_r) * label_weight
+            return grad, hess
+        return grad_fn
 
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         return 1.0 / (1.0 + np.exp(-2.0 * float(self.sigmoid) * score))

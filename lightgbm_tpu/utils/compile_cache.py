@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache.
+
+The reference binary pays no compilation cost; our fused training step
+costs ~20s of XLA compilation per (shape, config) the first time it runs.
+Enabling JAX's persistent compilation cache amortizes that to a one-time
+cost per machine: later processes deserialize the compiled executable in
+well under a second, which is what makes cold-process wall-clock
+competitive (BASELINE.md).
+
+Enabled on package import (see lightgbm_tpu/__init__.py).  Opt out with
+LIGHTGBM_TPU_NO_CACHE=1; override the location with
+LIGHTGBM_TPU_CACHE_DIR.
+"""
+
+import os
+
+_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Idempotently point JAX's persistent compilation cache at a
+    per-user directory and drop the min-size/min-time thresholds so every
+    executable (including sub-second ones) is cached."""
+    global _enabled
+    if _enabled or os.environ.get("LIGHTGBM_TPU_NO_CACHE") == "1":
+        return
+    try:
+        import jax
+        # an embedding process that configured its own cache (env var or
+        # jax.config) wins — never clobber it from a library import
+        if (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or jax.config.jax_compilation_cache_dir):
+            _enabled = True
+            return
+        cache_dir = os.environ.get(
+            "LIGHTGBM_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu",
+                         "jax_cache"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _enabled = True
+    except Exception:   # cache is an optimization; never fail import
+        pass
