@@ -216,6 +216,21 @@ class GBDT:
                 mesh, max_leaves=max(config.num_leaves, 2),
                 max_bin=self.max_bin, params=self.params,
                 max_depth=config.max_depth, hist_impl=impl)
+        # bounded histogram working set (the reference HistogramPool's
+        # role, feature_histogram.hpp:275-398): translate the MB budget
+        # into a slot count of [F, max_bin, 3] leaf histograms for the
+        # on-device LRU pool in ops/grow.py.  Parallel learners ignore it
+        # (config already reset it, mirroring config.cpp:167-175).
+        self.hist_slots = 0
+        if config.histogram_pool_size >= 0 and self.grower is None:
+            entry = (train_data.num_features * self.max_bin * 3
+                     * np.dtype(self.dtype).itemsize)
+            k = int(config.histogram_pool_size * 1024 * 1024
+                    / max(entry, 1))
+            k = max(2, k)   # smaller/larger pair minimum, like the pool's
+            if k <= max(config.num_leaves, 2):
+                self.hist_slots = k
+
         n_for_pad = self._n_pad_base if self._mh else n
         self.n_pad = ((n_for_pad + row_unit - 1) // row_unit) * row_unit
 
@@ -407,13 +422,15 @@ class GBDT:
         lr = self.shrinkage_rate
         key = (self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
-               cfg.max_depth, self.params, len(self.valid_bins_dev))
+               cfg.max_depth, self.params, len(self.valid_bins_dev),
+               self.hist_slots)
         fn = _FUSED_STEPS.get(key)
         if fn is None:
             grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
                            max_bin=self.max_bin, params=self.params,
                            max_depth=cfg.max_depth,
-                           hist_impl=self.hist_impl)
+                           hist_impl=self.hist_impl,
+                           hist_slots=self.hist_slots)
             fn = _make_fused_step(self.objective.make_grad_fn(), grow_kw,
                                   lr, self.dtype)
             _FUSED_STEPS[key] = fn
@@ -460,7 +477,7 @@ class GBDT:
                 bag_mask_dev, jnp.asarray(fmask),
                 max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
                 params=self.params, max_depth=cfg.max_depth,
-                hist_impl=self.hist_impl)
+                hist_impl=self.hist_impl, hist_slots=self.hist_slots)
 
         lr = self.shrinkage_rate
         # train-score update: leaf_value[leaf_id] gather for ALL rows —

@@ -62,11 +62,18 @@ class TreeArrays(NamedTuple):
 class GrowState(NamedTuple):
     tree: TreeArrays
     leaf_id: jax.Array          # [N] i32
-    hist: jax.Array             # [L+1, F, B, 3] (last = dummy slot)
+    hist: jax.Array             # [K+1, F, B, 3] (last = dummy slot);
+    #                             K = max_leaves (dense) or hist_slots (pool)
     leaf_sum_g: jax.Array       # [L+1] (last = dummy slot)
     leaf_sum_h: jax.Array       # [L+1]
     best_f: jax.Array           # [L+1, 8] float best-split fields
     best_i: jax.Array           # [L+1, 4] i32 best-split fields
+    # histogram-pool bookkeeping (HistogramPool, reference
+    # feature_histogram.hpp:275-398, re-designed as on-device LRU): only
+    # carried when hist_slots bounds the pool; zero-size arrays otherwise
+    leaf_slot: jax.Array        # [L+1] i32 slot of leaf's hist, -1 evicted
+    slot_leaf: jax.Array        # [K+1] i32 leaf occupying slot, -1 free
+    slot_used: jax.Array        # [K+1] i32 last-used scan step (LRU key)
 
 
 # column layout of the packed per-leaf best-split state.  Packing the
@@ -134,7 +141,8 @@ def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
     jax.jit,
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
                      "row_chunk", "psum_axis", "feature_axis",
-                     "voting_top_k", "hist_impl", "hist_agg", "num_shards"))
+                     "voting_top_k", "hist_impl", "hist_agg", "num_shards",
+                     "hist_slots"))
 def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               bag_mask: jax.Array, feature_mask: jax.Array, *,
               max_leaves: int, max_bin: int, params: SplitParams,
@@ -142,7 +150,8 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               psum_axis: Optional[str] = None,
               feature_axis: Optional[str] = None,
               voting_top_k: int = 0, hist_impl: str = "xla",
-              hist_agg: str = "psum", num_shards: int = 0):
+              hist_agg: str = "psum", num_shards: int = 0,
+              hist_slots: int = 0):
     """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
 
     bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
@@ -150,6 +159,15 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     hist_impl: "xla" (portable one-hot matmul) or "pallas" (TPU radix
     kernel, f32, max_bin<=256, N % 8192 == 0).
     psum_axis: mesh axis sharding rows (tree_learner=data).
+    hist_slots (>0): bound histogram HBM to hist_slots live [F, B, 3]
+    leaf histograms — the reference HistogramPool's role
+    (feature_histogram.hpp:275-398) without its host LRU machinery: an
+    on-device slot pool inside the scan, least-recently-used eviction,
+    and a full recompute of the parent histogram when it was evicted
+    (the reference recomputes evicted leaves the same way).  0 keeps the
+    dense [max_leaves+1, F, B, 3] tensor (every leaf cached; exactly the
+    subtraction-trick arithmetic of the reference's unbounded default,
+    histogram_pool_size=-1).
     hist_agg (with psum_axis): "psum" all-reduces the full histogram
     tensor; "scatter" is the owner-computes protocol of the reference
     (ReduceScatter + per-owner FindBestThreshold,
@@ -287,14 +305,24 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     best_f0 = best_f0.at[0].set(rbf)
     best_i0 = best_i0.at[0].set(rbi)
 
+    pooled = 0 < hist_slots < max_leaves + 1
+    K = hist_slots if pooled else max_leaves
+    if pooled:
+        leaf_slot0 = jnp.full(max_leaves + 1, -1, dtype=jnp.int32).at[0].set(0)
+        slot_leaf0 = jnp.full(K + 1, -1, dtype=jnp.int32).at[0].set(0)
+        slot_used0 = jnp.full(K + 1, -1, dtype=jnp.int32).at[0].set(0)
+    else:   # zero-size placeholders keep the scan-state pytree uniform
+        leaf_slot0 = slot_leaf0 = slot_used0 = jnp.zeros(0, dtype=jnp.int32)
+
     state = GrowState(
         tree=tree,
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
-        hist=jnp.zeros((max_leaves + 1, f, max_bin, 3), dtype=dtype)
+        hist=jnp.zeros((K + 1, f, max_bin, 3), dtype=dtype)
             .at[0].set(root_hist),
         leaf_sum_g=jnp.zeros(max_leaves + 1, dtype=dtype).at[0].set(root_g),
         leaf_sum_h=jnp.zeros(max_leaves + 1, dtype=dtype).at[0].set(root_h),
         best_f=best_f0, best_i=best_i0,
+        leaf_slot=leaf_slot0, slot_leaf=slot_leaf0, slot_used=slot_used0,
     )
 
     # Fixed-trip scan instead of lax.while_loop: a while_loop's per-
@@ -306,7 +334,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     # node slot for nodes) so the real state passes through untouched —
     # preserving the reference's early-stop semantics
     # (serial_tree_learner.cpp:121-129) without a whole-state select.
-    def step(st: GrowState, _):
+    def step(st: GrowState, t):
         tree = st.tree
         # argmax over leaves; first max ⇒ smaller leaf index, matching
         # ArrayArgs::ArgMax over best_split_per_leaf_ (serial_tree_learner.cpp:121)
@@ -370,10 +398,52 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         left_is_smaller = si[BI_LCNT] <= si[BI_RCNT]
         small_leaf = jnp.where(left_is_smaller, bl, right)
         small_hist = hist_leaf(leaf_id, small_leaf)
-        large_hist = st.hist[bl] - small_hist
+        if pooled:
+            # parent histogram from its pool slot, or a full recompute
+            # when it was LRU-evicted (the reference recomputes evicted
+            # leaves the same way, feature_histogram.hpp:275-398 +
+            # serial_tree_learner.cpp BeforeFindBestSplit)
+            parent_slot = st.leaf_slot[bl]
+            parent_hist = jax.lax.cond(
+                parent_slot >= 0,
+                lambda: st.hist[jnp.clip(parent_slot, 0, K - 1)],
+                lambda: hist_leaf(st.leaf_id, bl))
+        else:
+            parent_hist = st.hist[bl]
+        large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_is_smaller, large_hist, small_hist)
-        hist = st.hist.at[wl].set(left_hist).at[wr].set(right_hist)
+        if pooled:
+            # slot allocation: the left child (which keeps leaf index bl)
+            # reuses the parent's slot when cached, else takes the LRU
+            # slot; the right child takes the LRU slot among the rest
+            slot_l = jnp.where(
+                parent_slot >= 0, parent_slot,
+                jnp.argmin(st.slot_used[:K]).astype(jnp.int32))
+            used_tmp = st.slot_used.at[jnp.clip(slot_l, 0, K - 1)].set(t)
+            slot_r = jnp.argmin(used_tmp[:K]).astype(jnp.int32)
+            wsl = jnp.where(keep, slot_l, K)      # dummy-slot redirection
+            wsr = jnp.where(keep, slot_r, K)
+            hist = st.hist.at[wsl].set(left_hist).at[wsr].set(right_hist)
+            # drop the evicted occupants' mappings, then map the children
+            # (ordering matters: when the parent's slot is reused its
+            # occupant IS bl — cleared first, remapped after)
+            evict_l = st.slot_leaf[jnp.clip(slot_l, 0, K - 1)]
+            evict_r = st.slot_leaf[jnp.clip(slot_r, 0, K - 1)]
+            leaf_slot = (
+                st.leaf_slot
+                .at[jnp.where(keep & (evict_l >= 0), evict_l,
+                              max_leaves)].set(-1)
+                .at[jnp.where(keep & (evict_r >= 0), evict_r,
+                              max_leaves)].set(-1)
+                .at[wl].set(jnp.where(keep, slot_l, -1))
+                .at[wr].set(jnp.where(keep, slot_r, -1)))
+            slot_leaf = st.slot_leaf.at[wsl].set(bl).at[wsr].set(right)
+            slot_used = st.slot_used.at[wsl].set(t).at[wsr].set(t)
+        else:
+            hist = st.hist.at[wl].set(left_hist).at[wr].set(right_hist)
+            leaf_slot, slot_leaf, slot_used = (st.leaf_slot, st.slot_leaf,
+                                               st.slot_used)
 
         leaf_sum_g = st.leaf_sum_g.at[wl].set(sf[BF_LG]) \
                                   .at[wr].set(sf[BF_RG])
@@ -393,7 +463,10 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
         return GrowState(tree=new_tree, leaf_id=leaf_id, hist=hist,
                          leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
-                         best_f=best_f, best_i=best_i), None
+                         best_f=best_f, best_i=best_i,
+                         leaf_slot=leaf_slot, slot_leaf=slot_leaf,
+                         slot_used=slot_used), None
 
-    final, _ = jax.lax.scan(step, state, None, length=max_leaves - 1)
+    final, _ = jax.lax.scan(step, state,
+                            jnp.arange(1, max_leaves, dtype=jnp.int32))
     return final.tree, final.leaf_id

@@ -234,3 +234,60 @@ def test_grow_tree_bagging_mask():
     np.testing.assert_array_equal(bag_counts[:nl],
                                   np.asarray(tree.leaf_count)[:nl])
     assert int(np.asarray(tree.leaf_count)[:nl].sum()) == n // 2
+
+
+# ---- bounded histogram pool (hist_slots; reference HistogramPool role,
+# feature_histogram.hpp:275-398) --------------------------------------
+
+def _pool_workload(n=5000, f=12, b=64, seed=0):
+    rng = np.random.RandomState(seed)
+    bins_t = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    y = (rng.randn(n) + bins_t[0] / 16.0 > 2).astype(np.float64)
+    grad = 0.5 - y
+    hess = np.full(n, 0.25)
+    return bins_t, grad, hess
+
+
+@pytest.mark.parametrize("slots", [2, 3, 8, 31])
+def test_hist_pool_tree_identity(slots):
+    """A bounded pool (any size >= 2) must grow the IDENTICAL tree to the
+    dense unbounded default: eviction only trades memory for parent-
+    histogram recomputes, never changes the arithmetic outcome (f64)."""
+    n, f, b, L = 5000, 12, 64, 31
+    bins_t, grad, hess = _pool_workload(n, f, b)
+    params = SplitParams(20, 1e-3, 0.0, 0.0, 0.0)
+    args = (jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(n, dtype=bool), jnp.ones(f, dtype=bool))
+    kw = dict(max_leaves=L, max_bin=b, params=params)
+    dense_tree, dense_leaf = grow_tree(*args, **kw)
+    pool_tree, pool_leaf = grow_tree(*args, **kw, hist_slots=slots)
+    assert int(dense_tree.num_leaves) == L
+    for a, b_ in zip(dense_tree, pool_tree):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    np.testing.assert_array_equal(np.asarray(dense_leaf),
+                                  np.asarray(pool_leaf))
+
+
+@pytest.mark.slow
+def test_hist_pool_wide_shape():
+    """The VERDICT-r1 scale gap: num_leaves=255, F=2000, max_bin=256.
+    Dense histograms would need (255+1) x 2000 x 256 x 3 x 4B = 1.5 GB;
+    a 64-slot pool holds 381 MB and must still grow a valid deep tree.
+    (Rows are few — the claim under test is the histogram working-set
+    bound, which is independent of N.)"""
+    n, f, b, L, slots = 2048, 2000, 256, 255, 64
+    rng = np.random.RandomState(1)
+    bins_t = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    params = SplitParams(1, 0.0, 0.0, 0.0, 0.0)
+    tree, leaf_id = grow_tree(
+        jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, dtype=bool), jnp.ones(f, dtype=bool),
+        max_leaves=L, max_bin=b, params=params, hist_slots=slots)
+    nl = int(tree.num_leaves)
+    assert nl > L // 2   # pure-noise gradients split deep
+    # structural sanity of the deep tree: leaf counts partition the rows
+    counts = np.bincount(np.asarray(leaf_id), minlength=nl)
+    np.testing.assert_array_equal(counts[:nl],
+                                  np.asarray(tree.leaf_count)[:nl])
