@@ -46,6 +46,12 @@ MIN_DATA_IN_LEAF = 100
 LEARNING_RATE = 0.1
 SEED = 42
 
+# ranking micro-bench (device-path lambdarank, VERDICT r1 #6): synthetic
+# LETOR-ish workload, fixed-size queries
+RANK_DOCS = int(os.environ.get("BENCH_RANK_DOCS", 200_000))
+RANK_QSIZE = 20
+RANK_LEAVES = 31
+
 
 def make_data():
     rng = np.random.RandomState(SEED)
@@ -147,6 +153,106 @@ def run_ours():
             "auc": float(auc), "backend": jax.default_backend()}
 
 
+def make_rank_data():
+    rng = np.random.RandomState(SEED + 7)
+    x = rng.randn(RANK_DOCS, N_FEAT).astype(np.float32)
+    rel = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.5 * rng.randn(RANK_DOCS)
+    y = np.clip(np.round(rel + 1.5), 0, 4).astype(np.float32)
+    qb = np.arange(0, RANK_DOCS + 1, RANK_QSIZE, dtype=np.int32)
+    return x, y, qb
+
+
+def _rank_params():
+    return {
+        "objective": "lambdarank", "num_leaves": str(RANK_LEAVES),
+        "max_bin": str(MAX_BIN), "min_data_in_leaf": str(MIN_DATA_IN_LEAF),
+        "learning_rate": str(LEARNING_RATE), "metric": "",
+    }
+
+
+def run_ours_rank():
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.binning import find_bins
+    from lightgbm_tpu.io.dataset import Dataset, Metadata
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    x, y, qb = make_rank_data()
+    cfg = Config.from_params(_rank_params())
+    rng = np.random.RandomState(SEED)
+    sample = rng.choice(RANK_DOCS, min(50_000, RANK_DOCS), replace=False)
+    mappers = find_bins(x[sample], len(sample), cfg.max_bin)
+    bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
+                     for j, m in enumerate(mappers)])
+    md = Metadata(label=y, query_boundaries=qb)
+    ds = Dataset(bins=bins, bin_mappers=mappers,
+                 used_feature_map=np.arange(N_FEAT, dtype=np.int32),
+                 real_feature_index=np.arange(N_FEAT, dtype=np.int32),
+                 num_total_features=N_FEAT,
+                 feature_names=["Column_%d" % i for i in range(N_FEAT)],
+                 metadata=md)
+
+    def fresh():
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        return create_boosting(cfg, ds, obj)
+
+    warm = fresh()
+    warm.train_one_iter(None, None, False)
+    jax.block_until_ready(warm.scores)
+    del warm
+
+    booster = fresh()
+    t0 = time.time()
+    for _ in range(NUM_TREES):
+        booster.train_one_iter(None, None, False)
+    jax.block_until_ready(booster.scores)
+    float(np.asarray(booster.scores[0, 0]))
+    return {"rank_train_s": time.time() - t0}
+
+
+def run_reference_rank():
+    ncpu = os.cpu_count()
+    key = "refrank_%dx%d_q%d_t%d_l%d_b%d_cpu%d.json" % (
+        RANK_DOCS, N_FEAT, RANK_QSIZE, NUM_TREES, RANK_LEAVES, MAX_BIN, ncpu)
+    cache_f = os.path.join(CACHE, key)
+    if os.path.exists(cache_f):
+        with open(cache_f) as f:
+            return json.load(f)
+
+    exe = ensure_ref_binary()
+    os.makedirs(CACHE, exist_ok=True)
+    train_file = os.path.join(CACHE, "bench_rank_%d.train" % RANK_DOCS)
+    if not os.path.exists(train_file):
+        x, y, qb = make_rank_data()
+        np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
+                   fmt="%.6g", delimiter="\t")
+        with open(train_file + ".query", "w") as f:
+            for i in range(len(qb) - 1):
+                f.write("%d\n" % (qb[i + 1] - qb[i]))
+    out = subprocess.run(
+        [exe, "task=train", "data=" + train_file, "objective=lambdarank",
+         "num_trees=%d" % NUM_TREES, "num_leaves=%d" % RANK_LEAVES,
+         "max_bin=%d" % MAX_BIN, "min_data_in_leaf=%d" % MIN_DATA_IN_LEAF,
+         "learning_rate=%g" % LEARNING_RATE, "metric=",
+         "is_save_binary_file=false", "output_model=/dev/null"],
+        capture_output=True, text=True, cwd=CACHE, check=True)
+    last = None
+    for line in out.stdout.splitlines():
+        m = re.search(r"([\d.]+) seconds elapsed, finished iteration (\d+)",
+                      line)
+        if m:
+            last = (float(m.group(1)), int(m.group(2)))
+    if last is None or last[1] != NUM_TREES:
+        raise RuntimeError("could not parse reference rank timing:\n"
+                           + out.stdout)
+    res = {"ref_rank_train_s": last[0], "ncpu": ncpu}
+    with open(cache_f, "w") as f:
+        json.dump(res, f)
+    return res
+
+
 def ensure_ref_binary():
     exe = os.path.join(REF_BUILD, "ref_src", "lightgbm")
     if os.path.exists(exe):
@@ -208,24 +314,42 @@ def main():
     ours = run_ours()
     try:
         ref = run_reference()
-        vs = ref["ref_train_s"] / ours["train_s"]
     except Exception as e:  # reference unavailable: report ours alone
         ref = {"ref_train_s": None, "error": str(e)[:200]}
-        vs = 0.0
+    ref_s = ref.get("ref_train_s") or 0.0
+
+    extras = {}
+    if os.environ.get("BENCH_RANK", "1") != "0":
+        try:
+            r = run_ours_rank()
+            rr = run_reference_rank()
+            extras = {
+                "rank_train_s": round(r["rank_train_s"], 3),
+                "ref_rank_train_s": rr["ref_rank_train_s"],
+                "rank_vs_baseline": round(
+                    rr["ref_rank_train_s"] / r["rank_train_s"], 4),
+            }
+        except Exception as e:
+            extras = {"rank_error": str(e)[:200]}
+
+    # headline vs_baseline is the RAW wall-clock ratio (includes any
+    # transient tunnel stalls and the post-warm-up residual); the
+    # steady-state extrapolation min(chunk)*4 is reported alongside as
+    # vs_baseline_steady (ADVICE r1: wall is the honest primary).
     print(json.dumps({
-        "metric": "train_steady_100trees_1Mx28",
-        "value": round(ours["train_s"], 3),
+        "metric": "train_100trees_1Mx28",
+        "value": round(ours["train_total_s"], 3),
         "unit": "s",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": round(ref_s / ours["train_total_s"], 4),
         "ref_train_s": ref.get("ref_train_s"),
-        "train_total_s": round(ours["train_total_s"], 3),
-        "vs_baseline_wall": round((ref["ref_train_s"] or 0)
-                                  / ours["train_total_s"], 4),
+        "train_steady_s": round(ours["train_s"], 3),
+        "vs_baseline_steady": round(ref_s / ours["train_s"], 4),
         "compile_s": round(ours["compile_s"], 3),
         "auc_holdout": round(ours["auc"], 5),
         "backend": ours["backend"],
         "ncpu": os.cpu_count(),
         "trees_per_s": round(NUM_TREES / ours["train_s"], 3),
+        **extras,
     }))
 
 

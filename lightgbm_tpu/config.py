@@ -182,6 +182,7 @@ class Config:
     hist_dtype: str = "float32"           # histogram accumulator dtype
     hist_impl: str = "auto"               # auto | xla | pallas
     hist_agg: str = "psum"                # psum | scatter (tree_learner=data)
+    rank_impl: str = "device"             # device | native (lambdarank gradients)
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
@@ -319,6 +320,7 @@ class Config:
         set_str("hist_dtype")
         set_str("hist_impl")
         set_str("hist_agg")
+        set_str("rank_impl")
         set_bool("donate_buffers")
         set_str("device_type")
         if c.device_type not in ("", "cpu", "tpu"):
@@ -330,6 +332,9 @@ class Config:
         if c.hist_agg not in ("psum", "scatter"):
             log.fatal("Unknown hist_agg %s (expect psum|scatter)"
                       % c.hist_agg)
+        if c.rank_impl not in ("device", "native"):
+            log.fatal("Unknown rank_impl %s (expect device|native)"
+                      % c.rank_impl)
         if c.hist_dtype not in ("float32", "float64"):
             log.fatal("Unknown hist_dtype %s (expect float32|float64)"
                       % c.hist_dtype)

@@ -218,9 +218,17 @@ class MulticlassSoftmax(Objective):
         self.weights = self._pad(self.weights, n_pad)
 
     def get_gradients(self, score):
-        """score [K, N] -> grad/hess [K, N]."""
+        """score [K, N] -> grad/hess [K, N].
+
+        The softmax itself runs in float64 with the result cast to
+        float32, reproducing the reference's double-precision
+        Common::Softmax rec[] with score_t p = (float)rec[k]
+        (multiclass_objective.hpp:35-53, common.h:353-367) — under
+        default x64-disabled JAX the cast is a no-op and everything
+        stays f32."""
         score = score.astype(jnp.float32)
-        p = jax.nn.softmax(score, axis=0)
+        p = jax.nn.softmax(score.astype(jnp.float64), axis=0) \
+            .astype(jnp.float32)
         grad = p - self.onehot
         hess = 2.0 * p * (1.0 - p)
         if self.weights is not None:
@@ -237,9 +245,28 @@ _SIGMOID_BINS = 1024 * 1024
 
 
 class LambdarankNDCG(Objective):
+    """LambdaRank with NDCG deltas (reference rank_objective.hpp:41-192).
+
+    Two gradient paths, selected by ``rank_impl``:
+
+    - ``device`` (default): the pairwise per-query computation expressed
+      as jnp over padded ``[Q, Lmax]`` query blocks — scores never leave
+      the device, the objective traces into the fused training step, and
+      the O(L^2) pair tensors are bounded by scanning fixed-size query
+      blocks.  Tie order under equal scores follows a STABLE descending
+      sort (documented divergence from the reference's non-stable
+      std::sort tie permutation; PARITY.md).
+    - ``native``: the bit-parity C++ kernel (native/ingest.cpp) that
+      reproduces the reference's libstdc++ sort permutation and
+      sequential fp32 pair accumulation digit-for-digit — kept as the
+      golden-parity oracle, with a vectorized numpy fallback.
+    """
+
     name = "lambdarank"
 
     def __init__(self, config: Config):
+        self.impl = getattr(config, "rank_impl", "device")
+        self.jax_traceable = self.impl == "device"
         self.sigmoid = np.float32(config.sigmoid)
         if self.sigmoid <= 0:
             log.fatal("Sigmoid param %f should be greater than zero"
@@ -275,6 +302,120 @@ class LambdarankNDCG(Objective):
             inv[q] = 1.0 / m if m > 0 else m
         self.inverse_max_dcgs = inv
         self.weights = metadata.weights
+        if self.impl == "device":
+            self._build_device_state()
+
+    # -- device path ---------------------------------------------------
+    def _build_device_state(self) -> None:
+        """Pack queries into padded [nb, QB, Lmax] blocks for the jnp
+        gradient path.  QB bounds the [QB, Lmax, Lmax] pair tensors that
+        dominate memory (scanned block-by-block), so HBM use is
+        ~O(QB * Lmax^2) regardless of query count."""
+        qb = np.asarray(self.qb, dtype=np.int64)
+        nq = len(qb) - 1
+        qlen = (qb[1:] - qb[:-1]).astype(np.int64)
+        lmax = max(1, int(qlen.max()) if nq else 1)
+        # ~16M pair elements per scanned block (~64 MB of f32 temps)
+        q_block = int(min(max(1, (1 << 24) // (lmax * lmax)), max(nq, 1)))
+        nb = max(1, -(-nq // q_block))
+        nq_pad = nb * q_block
+        label = np.asarray(self.metadata.label)
+
+        doc_idx = np.zeros((nq_pad, lmax), dtype=np.int32)
+        lab = np.full((nq_pad, lmax), -1, dtype=np.int32)
+        gain = np.zeros((nq_pad, lmax), dtype=np.float32)
+        wts = np.ones((nq_pad, lmax), dtype=np.float32)
+        inv = np.zeros(nq_pad, dtype=np.float32)
+        inv[:nq] = self.inverse_max_dcgs
+        ar = np.arange(lmax, dtype=np.int64)
+        for q in range(nq):
+            a, ln = int(qb[q]), int(qlen[q])
+            idx = a + np.minimum(ar, max(ln - 1, 0))
+            doc_idx[q] = idx
+            lab[q, :ln] = label[a:a + ln].astype(np.int32)
+            gain[q, :ln] = self.label_gain[lab[q, :ln]]
+            if self.weights is not None:
+                wts[q, :ln] = self.weights[a:a + ln]
+
+        shp = (nb, q_block)
+        self._dev_state = (
+            jnp.asarray(doc_idx.reshape(shp + (lmax,))),
+            jnp.asarray(lab.reshape(shp + (lmax,))),
+            jnp.asarray(gain.reshape(shp + (lmax,))),
+            jnp.asarray(inv.reshape(shp)),
+            jnp.asarray(wts.reshape(shp + (lmax,))),
+            jnp.asarray(self.sigmoid_table),
+            jnp.asarray(self.discount),
+        )
+        self._dev_fn = jax.jit(self.make_grad_fn())
+
+    def fused_key(self):
+        if self.impl != "device":
+            return None
+        return ("lambdarank", float(self.sigmoid))
+
+    def grad_state(self):
+        return self._dev_state
+
+    def make_grad_fn(self):
+        min_in = float(self.min_in)
+        max_in = float(self.max_in)
+        idx_factor = float(self.idx_factor)
+
+        def grad_fn(score, state):
+            doc_idx, lab, gain, inv, wts, sig_table, disc_table = state
+            score = score.astype(jnp.float32)
+            n_pad = score.shape[0]
+            n_bins = sig_table.shape[0]
+            n_disc = disc_table.shape[0]
+
+            def block(carry, xs):
+                lam_out, hess_out = carry
+                di, lb, gn, iv, wb = xs
+                valid = lb >= 0
+                s = score[di]                           # [QB, L]
+                s_sort = jnp.where(valid, s, -jnp.inf)
+                # stable descending sort: first-by-score, ties by index
+                # (reference uses non-stable std::sort — PARITY.md)
+                order = jnp.argsort(-s_sort, axis=-1)
+                rank_of = jnp.argsort(order, axis=-1)
+                dsc = disc_table[jnp.minimum(rank_of, n_disc - 1)]
+                dsc = jnp.where(valid, dsc, 0.0)
+                best = jnp.max(s_sort, axis=-1)
+                worst = jnp.min(jnp.where(valid, s, jnp.inf), axis=-1)
+                norm = (best != worst)[:, None, None]
+                ds = s[:, :, None] - s[:, None, :]      # [QB, L, L]
+                vp = ((lb[:, :, None] > lb[:, None, :])
+                      & valid[:, :, None] & valid[:, None, :])
+                delta = ((gn[:, :, None] - gn[:, None, :])
+                         * jnp.abs(dsc[:, :, None] - dsc[:, None, :])
+                         * iv[:, None, None])
+                delta = jnp.where(
+                    norm, delta / (jnp.float32(0.01) + jnp.abs(ds)), delta)
+                # sigmoid lookup (rank_objective.hpp:175-189 table+index)
+                idx = jnp.clip(((ds - min_in) * idx_factor)
+                               .astype(jnp.int32), 0, n_bins - 1)
+                p_lam = sig_table[idx]
+                p_lam = jnp.where(ds <= min_in, sig_table[0], p_lam)
+                p_lam = jnp.where(ds >= max_in, sig_table[-1], p_lam)
+                p_hess = p_lam * (jnp.float32(2.0) - p_lam)
+                p_lam = jnp.where(vp, p_lam * -delta, 0.0)
+                p_hess = jnp.where(vp, p_hess * jnp.float32(2.0) * delta,
+                                   0.0)
+                lam_doc = p_lam.sum(axis=2) - p_lam.sum(axis=1)
+                hess_doc = p_hess.sum(axis=2) + p_hess.sum(axis=1)
+                lam_doc = jnp.where(valid, lam_doc * wb, 0.0)
+                hess_doc = jnp.where(valid, hess_doc * wb, 0.0)
+                return (lam_out.at[di].add(lam_doc),
+                        hess_out.at[di].add(hess_doc)), None
+
+            init = (jnp.zeros(n_pad, jnp.float32),
+                    jnp.zeros(n_pad, jnp.float32))
+            (lam, hes), _ = jax.lax.scan(
+                block, init, (doc_idx, lab, gain, inv, wts))
+            return lam, hes
+
+        return grad_fn
 
     def _sigmoid_lut(self, s: np.ndarray) -> np.ndarray:
         idx = ((s - self.min_in) * self.idx_factor).astype(np.int64)
@@ -285,6 +426,8 @@ class LambdarankNDCG(Objective):
         return out
 
     def get_gradients(self, score):
+        if self.impl == "device":
+            return self._dev_fn(jnp.asarray(score), self._dev_state)
         score_np = np.asarray(score, dtype=np.float32)
         # Reference-order native path: bit-parity with the golden models
         # needs libstdc++ std::sort tie permutations and sequential fp32

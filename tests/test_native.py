@@ -136,7 +136,8 @@ def test_native_lambdarank_matches_python_fallback():
     label = rng.randint(0, 4, size=n).astype(np.float32)
     score = rng.randn(n).astype(np.float32)  # untied with prob 1
 
-    cfg = Config.from_params({"objective": "lambdarank"})
+    cfg = Config.from_params({"objective": "lambdarank",
+                              "rank_impl": "native"})
     obj = LambdarankNDCG(cfg)
     obj.init(Metadata(label=label, query_boundaries=qb), n)
     obj.pad_to(n)
@@ -156,6 +157,47 @@ def test_native_lambdarank_matches_python_fallback():
         native._lib, native._tried = None, False
     np.testing.assert_allclose(lam_n, lam_p, rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(hes_n, hes_p, rtol=2e-5, atol=1e-7)
+
+
+def test_device_lambdarank_matches_fallback():
+    """Default device (jnp) lambdarank gradients vs the vectorized numpy
+    fallback: same math over padded query blocks, so fp32-tolerance
+    agreement on untied scores, weighted and unweighted."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.objectives import LambdarankNDCG
+
+    rng = np.random.RandomState(3)
+    n, nq = 400, 17
+    qb = np.sort(rng.choice(np.arange(1, n), nq - 1, replace=False))
+    qb = np.concatenate([[0], qb, [n]]).astype(np.int32)
+    label = rng.randint(0, 4, size=n).astype(np.float32)
+    score = rng.randn(n).astype(np.float32)
+    w = rng.rand(n).astype(np.float32)
+    n_pad = 512
+    pad_score = np.concatenate([score, np.zeros(n_pad - n, np.float32)])
+
+    os.environ["LGBM_TPU_NO_NATIVE"] = "1"
+    try:
+        native._lib, native._tried = None, False
+        for weights in (None, w):
+            md = Metadata(label=label, query_boundaries=qb, weights=weights)
+            dev = LambdarankNDCG(Config.from_params(
+                {"objective": "lambdarank"}))
+            dev.init(md, n)
+            dev.pad_to(n_pad)
+            assert dev.jax_traceable and dev.fused_key() is not None
+            fal = LambdarankNDCG(Config.from_params(
+                {"objective": "lambdarank", "rank_impl": "native"}))
+            fal.init(md, n)
+            fal.pad_to(n_pad)
+            ld, hd = (np.asarray(a) for a in dev.get_gradients(pad_score))
+            lf, hf = (np.asarray(a) for a in fal.get_gradients(pad_score))
+            np.testing.assert_allclose(ld, lf, rtol=3e-5, atol=1e-6)
+            np.testing.assert_allclose(hd, hf, rtol=3e-5, atol=1e-6)
+    finally:
+        del os.environ["LGBM_TPU_NO_NATIVE"]
+        native._lib, native._tried = None, False
 
 
 def test_native_ndcg_matches_python_fallback():
