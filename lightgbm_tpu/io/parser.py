@@ -10,6 +10,7 @@ ingest plugs in behind the same interface.
 
 from __future__ import annotations
 
+import re
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -35,9 +36,69 @@ def detect_format(sample_lines: List[str]) -> str:
     return "tsv"
 
 
+_PLAIN_DECIMAL = re.compile(r"^[+-]?[0-9]+(\.[0-9]*)?([eE][+-]?[0-9]+)?$"
+                            r"|^[+-]?\.[0-9]+([eE][+-]?[0-9]+)?$")
+
+
+def _atof_value(t: str) -> float:
+    """The reference Atof's digit-accumulation arithmetic, replicated
+    bit-for-bit (common.h:110-172): integer digits via value*10+d, fraction
+    via value += d/pow10, exponent via repeated scale multiplies.  This is
+    NOT correctly-rounded decimal conversion — it can differ from float(t)
+    by a few ulp — and that difference is load-bearing: ValueToBin of a
+    knife-edge value (e.g. "-1.857" against a bin boundary at
+    -1.8570000000000002) lands in a different bin under float(t), which
+    diverges validation-score trajectories from the reference."""
+    p, n = 0, len(t)
+    sign = 1.0
+    if p < n and t[p] == "-":
+        sign = -1.0
+        p += 1
+    elif p < n and t[p] == "+":
+        p += 1
+    value = 0.0
+    while p < n and t[p].isdigit():
+        value = value * 10.0 + (ord(t[p]) - 48)
+        p += 1
+    if p < n and t[p] == ".":
+        pow10 = 10.0
+        p += 1
+        while p < n and t[p].isdigit():
+            value += (ord(t[p]) - 48) / pow10
+            pow10 *= 10.0
+            p += 1
+    frac = False
+    scale = 1.0
+    if p < n and t[p] in "eE":
+        p += 1
+        if p < n and t[p] == "-":
+            frac = True
+            p += 1
+        elif p < n and t[p] == "+":
+            p += 1
+        expon = 0
+        while p < n and t[p].isdigit():
+            expon = expon * 10 + (ord(t[p]) - 48)
+            p += 1
+        if expon > 308:
+            expon = 308
+        while expon >= 50:
+            scale *= 1e50
+            expon -= 50
+        while expon >= 8:
+            scale *= 1e8
+            expon -= 8
+        while expon > 0:
+            scale *= 10.0
+            expon -= 1
+    return sign * (value / scale if frac else value * scale)
+
+
 def _clean_token(tok: str) -> float:
     """Reference Atof token semantics (common.h:200-290): na/nan/empty -> 0
-    (null accepted as an extension), inf -> +-1e308, unknown -> fatal."""
+    (null accepted as an extension), inf -> +-1e308, unknown -> fatal.
+    Plain decimal tokens take the reference's exact (imprecise) digit
+    arithmetic via _atof_value; float() is used only to validate."""
     t = tok.strip().lower()
     if t in ("", "na", "nan", "null"):
         return 0.0
@@ -47,6 +108,8 @@ def _clean_token(tok: str) -> float:
         log.fatal("Unknown token %s in data file" % tok)
     if v != v:
         return 0.0
+    if _PLAIN_DECIMAL.match(t):
+        return _atof_value(t)
     return min(max(v, -1e308), 1e308)
 
 
@@ -58,19 +121,17 @@ def parse_dense(lines: List[str], sep: str, label_idx: int
     CSVParser/TSVParser (reference src/io/parser.hpp:15-75).
     """
     rows = [line.rstrip("\r\n").split(sep) for line in lines]
-    try:
-        data = np.array(rows, dtype=np.float64)
-    except ValueError:
-        # slow path with token cleanup (na/nan/ragged handling)
-        ncol = len(rows[0])
-        data = np.empty((len(rows), ncol), dtype=np.float64)
-        for i, toks in enumerate(rows):
-            vals = [_clean_token(t) for t in toks[:ncol]]
-            vals.extend([0.0] * (ncol - len(vals)))  # short rows 0-filled
-            data[i] = vals
-    if not np.isfinite(data).all():
-        # nan -> 0 and inf -> +-1e308, like the reference Atof
-        data = np.nan_to_num(data, nan=0.0, posinf=1e308, neginf=-1e308)
+    # token-by-token so every value goes through the reference's exact
+    # Atof arithmetic (_clean_token) — a vectorized np.array parse is
+    # correctly-rounded and diverges by ulps on e.g. "1.457" (see
+    # _atof_value); the native parser (ingest.cpp) is the fast path,
+    # this fallback favors bit-parity over speed
+    ncol = len(rows[0])
+    data = np.empty((len(rows), ncol), dtype=np.float64)
+    for i, toks in enumerate(rows):
+        vals = [_clean_token(t) for t in toks[:ncol]]
+        vals.extend([0.0] * (ncol - len(vals)))  # short rows 0-filled
+        data[i] = vals
     label = data[:, label_idx].copy()
     feats = np.delete(data, label_idx, axis=1)
     return label, feats

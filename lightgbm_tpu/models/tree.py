@@ -14,6 +14,8 @@ from typing import List
 
 import numpy as np
 
+from ..io.parser import _clean_token
+
 
 def _fmt(x: float) -> str:
     """C++ `ostream << double` default formatting (6 significant digits)."""
@@ -88,9 +90,21 @@ class Tree:
             return np.array(kv[key].split()[:cnt], dtype=np.int32)
 
         def floats(key, cnt):
+            # the reference reads model doubles back through its Atof
+            # (StringToArray<double>, common.h:229-247 -> Atof), whose
+            # digit arithmetic is NOT correctly-rounded — parse the same
+            # way so loaded thresholds compare against Atof-parsed data
+            # values exactly as the reference binary would.  Native batch
+            # path keeps big-model loads fast; token loop is the fallback.
             if cnt <= 0:
                 return np.zeros(0, np.float64)
-            return np.array(kv[key].split()[:cnt], dtype=np.float64)
+            toks = kv[key].split()[:cnt]
+            from .. import native
+            nat = native.parse_doubles(" ".join(toks).encode(), len(toks))
+            if nat is not None:
+                return nat
+            return np.array([_clean_token(t) for t in toks],
+                            dtype=np.float64)
 
         sf = ints("split_feature", nl - 1)
         return Tree(

@@ -109,6 +109,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_ndcg_eval.argtypes = [pf, pf, pi32, i64, pi32, i64, pf, i64,
                                   pf, pd]
     lib.lgt_ndcg_eval.restype = None
+    lib.lgt_parse_doubles.argtypes = [ctypes.c_char_p, i64, pd, i64]
+    lib.lgt_parse_doubles.restype = i64
     _lib = lib
     return _lib
 
@@ -137,6 +139,20 @@ def parse_dense(text: bytes, sep: str) -> Optional[np.ndarray]:
         from ..utils import log
         log.fatal("Unknown token in data file at row %d" % (-got - 1))
     return out[:got]
+
+
+def parse_doubles(text: bytes, n: int) -> Optional[np.ndarray]:
+    """Whitespace-separated doubles via the reference's Atof arithmetic
+    (common.h:229-247), or None when native is unavailable / a token is
+    malformed.  Fast path for model-file float arrays."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty(n, dtype=np.float64)
+    got = lib.lgt_parse_doubles(text, len(text), _dbl_ptr(out), n)
+    if got != n:
+        return None
+    return out
 
 
 def parse_libsvm(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:

@@ -36,6 +36,69 @@ inline bool in_set(const char* set, char c) {
   return false;
 }
 
+// The reference Atof's digit-accumulation arithmetic, bit-for-bit
+// (common.h:110-172).  NOT correctly-rounded conversion: it can differ
+// from strtod by ulps, and ValueToBin of knife-edge values (e.g. "1.457"
+// against a boundary at 1.4569999999999999) then lands in a different
+// bin, diverging validation scores from the reference.  Only called for
+// tokens strtod already validated as plain decimals.
+inline double atof_ref(const char* b, const char* e) {
+  const char* p = b;
+  double sign = 1.0;
+  if (p < e && *p == '-') { sign = -1.0; ++p; }
+  else if (p < e && *p == '+') ++p;
+  double value = 0.0;
+  while (p < e && *p >= '0' && *p <= '9') {
+    value = value * 10.0 + (*p - '0');
+    ++p;
+  }
+  if (p < e && *p == '.') {
+    double pow10 = 10.0;
+    ++p;
+    while (p < e && *p >= '0' && *p <= '9') {
+      value += (*p - '0') / pow10;
+      pow10 *= 10.0;
+      ++p;
+    }
+  }
+  int frac = 0;
+  double scale = 1.0;
+  if (p < e && (*p == 'e' || *p == 'E')) {
+    ++p;
+    if (p < e && *p == '-') { frac = 1; ++p; }
+    else if (p < e && *p == '+') ++p;
+    unsigned int expon = 0;
+    while (p < e && *p >= '0' && *p <= '9') {
+      expon = expon * 10 + (*p - '0');
+      ++p;
+    }
+    if (expon > 308) expon = 308;
+    while (expon >= 50) { scale *= 1E50; expon -= 50; }
+    while (expon >= 8) { scale *= 1E8; expon -= 8; }
+    while (expon > 0) { scale *= 10.0; expon -= 1; }
+  }
+  return sign * (frac ? (value / scale) : (value * scale));
+}
+
+inline bool is_plain_decimal(const char* b, const char* e) {
+  const char* p = b + ((b < e && (*b == '+' || *b == '-')) ? 1 : 0);
+  if (p == e) return false;
+  bool digit = false;
+  while (p < e && *p >= '0' && *p <= '9') { digit = true; ++p; }
+  if (p < e && *p == '.') {
+    ++p;
+    while (p < e && *p >= '0' && *p <= '9') { digit = true; ++p; }
+  }
+  if (!digit) return false;
+  if (p < e && (*p == 'e' || *p == 'E')) {
+    ++p;
+    if (p < e && (*p == '+' || *p == '-')) ++p;
+    if (p == e) return false;
+    while (p < e && *p >= '0' && *p <= '9') ++p;
+  }
+  return p == e;
+}
+
 // Token semantics of the reference Atof (common.h:200-290) and the Python
 // fallback's _clean_token (io/parser.py): the WHOLE token (up to the next
 // terminator in `terms` or EOL, whitespace-stripped) must be numeric, or
@@ -63,6 +126,7 @@ inline double parse_value(const char* p, const char* end, const char* terms,
   char* q = nullptr;
   double v = c_loc ? strtod_l(b, &q, c_loc) : std::strtod(b, &q);
   if (q == e) {  // fully numeric (partial consumption falls through)
+    if (is_plain_decimal(b, e)) return atof_ref(b, e);
     if (v != v) v = 0.0;       // "nan" via strtod -> 0 like the reference
     if (v > 1e308) v = 1e308;  // "inf" -> +-1e308 (common.h:284)
     if (v < -1e308) v = -1e308;
@@ -363,6 +427,29 @@ void lgt_ndcg_eval(const float* score, const float* label, const int32_t* qb,
 // comparator, same libstdc++) over (count, position) pairs reproduces the
 // permutation exactly: every control-flow decision in introsort is a
 // comparator call, and the comparator never reads .second.
+// Whitespace-separated doubles with the reference's Atof semantics
+// (StringToArray<double>, common.h:229-247): fills out[0..n), returns the
+// number parsed, or -1 on an unknown token.  Fast path for reading model
+// files back (tree.py Tree.from_string float arrays).
+int64_t lgt_parse_doubles(const char* buf, int64_t len, double* out,
+                          int64_t n) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t cnt = 0;
+  bool ok = true;
+  while (p < end && cnt < n) {
+    while (p < end && (*p == ' ' || *p == '\t' || is_eol(*p))) ++p;
+    if (p >= end) break;
+    const char* q = p;
+    while (q < end && *q != ' ' && *q != '\t' && !is_eol(*q)) ++q;
+    const char* dummy = nullptr;
+    out[cnt++] = parse_value(p, q, "", &dummy, &ok);
+    if (!ok) return -1;
+    p = q;
+  }
+  return cnt;
+}
+
 void lgt_sort_importance(const uint64_t* counts, int64_t n, int32_t* perm) {
   std::vector<std::pair<size_t, size_t>> pairs(n);
   for (int64_t i = 0; i < n; ++i)
