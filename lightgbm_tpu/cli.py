@@ -150,28 +150,92 @@ class Application:
             cfg.num_model_predict * self.boosting.num_class
             if cfg.num_model_predict >= 0 else NO_LIMIT)
 
+    # rows per streamed predict block; memory is bounded by this
+    # regardless of input file size
+    PREDICT_STREAM_ROWS = 1 << 16
+
     def predict(self) -> None:
-        """File prediction (reference src/application/predictor.hpp:82-130)."""
+        """Streaming file prediction.
+
+        The reference streams the input in blocks with parse, predict and
+        write overlapped across OpenMP threads (predictor.hpp:82-130,
+        text_reader.h:214-290).  Here: a parse-ahead thread tokenizes
+        block i+1 while block i runs the stacked-tree traversal on
+        device, and formatted rows stream to the output file — bounded
+        memory for arbitrarily large inputs, byte-identical output to the
+        whole-file path (goldens in test_e2e_parity pin all three modes).
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
         cfg = self.config
         log.info("Started prediction...")
-        with open(cfg.data) as f:
-            lines = [ln for ln in f.read().splitlines() if ln.strip()]
-        if cfg.has_header:
-            lines = lines[1:]
-        _, feats, _ = parse_file_lines(lines, self.boosting.label_idx)
-        if cfg.is_predict_leaf_index:
-            out = self.boosting.predict_leaf_index(feats)   # [N, T]
-            rows = ("\t".join(str(int(v)) for v in row) for row in out)
-        else:
+        booster = self.boosting
+        label_idx = booster.label_idx
+        n_total_feat = booster.max_feature_idx + 1
+
+        def blocks():
+            buf = []
+            with open(cfg.data) as f:
+                # skip the first NON-blank line as the header, matching
+                # _set_init_scores and io/dataset._skip_header
+                skip = cfg.has_header
+                for ln in f:
+                    if not ln.strip():
+                        continue
+                    if skip:
+                        skip = False
+                        continue
+                    buf.append(ln)
+                    if len(buf) >= self.PREDICT_STREAM_ROWS:
+                        yield buf
+                        buf = []
+            if buf:
+                yield buf
+
+        fmt = [None]
+
+        def parse(lines):
+            _, feats, f = parse_file_lines(lines, label_idx, fmt[0])
+            fmt[0] = f  # sniff once, reuse for every later block
+            if feats.shape[1] < n_total_feat:  # short rows (e.g. libsvm)
+                feats = np.pad(feats,
+                               ((0, 0), (0, n_total_feat - feats.shape[1])))
+            elif feats.shape[1] > n_total_feat:
+                # columns past the model's max_feature_idx are never read
+                # by any tree; one stable width keeps one compiled
+                # traversal executable across blocks
+                feats = feats[:, :n_total_feat]
+            return feats
+
+        def format_rows(feats):
+            if cfg.is_predict_leaf_index:
+                out = booster.predict_leaf_index(feats)      # [N, T]
+                return ["\t".join(str(int(v)) for v in row) for row in out]
             if cfg.is_predict_raw_score:
-                res = self.boosting.predict_raw(feats)       # [K, N]
+                res = booster.predict_raw(feats)             # [K, N]
             else:
-                res = self.boosting.predict(feats)
-            rows = ("\t".join("%g" % v for v in res[:, i])
-                    for i in range(res.shape[1]))
-        with open(cfg.output_result, "w") as f:
-            for row in rows:
-                f.write(row + "\n")
+                res = booster.predict(feats)
+            return ["\t".join("%g" % v for v in res[:, i])
+                    for i in range(res.shape[1])]
+
+        gen = blocks()
+        wrote = False
+        with open(cfg.output_result, "w") as out_f, \
+                ThreadPoolExecutor(max_workers=1) as ex:
+            pending = None
+            for lines in gen:
+                nxt = ex.submit(parse, lines)
+                if pending is not None:
+                    for row in format_rows(pending.result()):
+                        out_f.write(row + "\n")
+                    wrote = True
+                pending = nxt
+            if pending is not None:
+                for row in format_rows(pending.result()):
+                    out_f.write(row + "\n")
+                wrote = True
+        if not wrote:
+            log.fatal("Data file %s is empty" % cfg.data)
         log.info("Finished prediction, results saved to %s"
                  % cfg.output_result)
 

@@ -686,16 +686,86 @@ class GBDT:
         return [v for m in self.valid_metrics[i] for v in m.eval(score)]
 
     # ------------------------------------------------------------------
-    # prediction over raw feature values (host path; the device path is
-    # ops/predict.predict_leaf_raw)
+    # prediction over raw feature values.  Default path: stacked-tree
+    # device traversal (ops/predict.predict_leaf_stacked) in bounded row
+    # chunks — the reference's whole-file host loop
+    # (predictor.hpp:35-70) redesigned as data-parallel descents.  The
+    # device routes with (hi, lo) f32 pair compares (f64-faithful, no
+    # x64 needed); leaf-value accumulation happens on the host in f64,
+    # so output formatting stays byte-identical to the reference under
+    # any backend configuration.
+    PREDICT_CHUNK = 1 << 17
+
+    def _stacked_trees(self, nmodels: int):
+        """Padded [T, M]/[T, L] arrays for the first nmodels trees,
+        cached until the model list grows."""
+        from ..ops.predict import split_hi_lo
+        # keyed on iter too: DART renormalizes EXISTING trees' leaf values
+        # in place between iterations (dart.hpp Normalize), so a pack from
+        # an earlier iteration would be stale
+        key = (nmodels, self.iter)
+        cached = getattr(self, "_stack_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        trees = self.models[:nmodels]
+        max_l = max(t.num_leaves for t in trees)
+        m = max(1, max_l - 1)
+        sf = np.zeros((nmodels, m), dtype=np.int32)
+        thr = np.zeros((nmodels, m), dtype=np.float64)
+        lc = np.full((nmodels, m), -1, dtype=np.int32)
+        rc = np.full((nmodels, m), -1, dtype=np.int32)
+        lv = np.zeros((nmodels, max_l), dtype=np.float64)
+        for i, t in enumerate(trees):
+            ni = t.num_leaves - 1
+            if ni > 0:
+                sf[i, :ni] = t.split_feature_real[:ni]
+                thr[i, :ni] = t.threshold[:ni]
+                lc[i, :ni] = t.left_child[:ni]
+                rc[i, :ni] = t.right_child[:ni]
+            # ni == 0 keeps lc[0] == -1 == ~0: every row lands in leaf 0
+            lv[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        th, tl = split_hi_lo(thr)
+        dev = tuple(jnp.asarray(a) for a in (sf, th, tl, lc, rc))
+        pack = (dev, lv)
+        self._stack_cache = (key, pack)
+        return pack
+
+    def _predict_leaves(self, x: np.ndarray, nmodels: int) -> np.ndarray:
+        """[N, F] raw values -> [N, T] i32 leaf indices via the device
+        traversal, chunked so memory stays bounded."""
+        from ..ops.predict import predict_leaf_stacked, split_hi_lo
+        x = np.asarray(x, dtype=np.float64)
+        want = self.max_feature_idx + 1
+        if x.shape[1] < want:
+            # absent trailing features read as 0.0, the reference's
+            # missing-value convention (predictor.hpp feature buffer) —
+            # a narrow matrix must not silently gather-clamp on device
+            x = np.pad(x, ((0, 0), (0, want - x.shape[1])))
+        dev, _ = self._stacked_trees(nmodels)
+        n = x.shape[0]
+        out = np.empty((n, nmodels), dtype=np.int64)
+        for a in range(0, n, self.PREDICT_CHUNK):
+            xh, xl = split_hi_lo(
+                np.ascontiguousarray(x[a:a + self.PREDICT_CHUNK]))
+            out[a:a + self.PREDICT_CHUNK] = np.asarray(
+                predict_leaf_stacked(*dev, jnp.asarray(xh),
+                                     jnp.asarray(xl)))
+        return out
+
     def predict_raw(self, x: np.ndarray) -> np.ndarray:
         """x [N, num_total_features] -> [K, N] raw scores."""
         k = self.num_class
         n = x.shape[0]
-        out = np.zeros((k, n), dtype=np.float64)
         nmodels = self.num_used_model * k
-        for i, tree in enumerate(self.models[:nmodels]):
-            out[i % k] += tree.predict(x)
+        if nmodels == 0 or n == 0:
+            return np.zeros((k, n), dtype=np.float64)
+        leaves = self._predict_leaves(x, nmodels)
+        _, lv = self._stacked_trees(nmodels)
+        out = np.zeros((k, n), dtype=np.float64)
+        # per-tree f64 accumulation in boosting order, exactly the
+        # reference predictor's += tree->Predict (predictor.hpp:35-70)
+        for i in range(nmodels):
+            out[i % k] += lv[i, leaves[:, i]]
         return out
 
     def predict(self, x: np.ndarray) -> np.ndarray:
@@ -710,8 +780,10 @@ class GBDT:
     def predict_leaf_index(self, x: np.ndarray) -> np.ndarray:
         k = self.num_class
         nmodels = self.num_used_model * k
-        return np.stack([t.predict_leaf_index(x)
-                         for t in self.models[:nmodels]], axis=1)
+        n = x.shape[0]
+        if nmodels == 0 or n == 0:
+            return np.zeros((n, nmodels), dtype=np.int64)
+        return self._predict_leaves(x, nmodels)
 
     def set_num_used_model(self, num: int) -> None:
         if num >= 0:
@@ -885,7 +957,9 @@ class GBDT:
         self.max_feature_idx = int(ln.split("=")[1])
         ln = find_line("sigmoid=")
         if ln:
-            self.sigmoid = float(ln.split("=")[1])
+            # Atof semantics, like every double the reference reads back
+            from ..io.parser import _clean_token
+            self.sigmoid = _clean_token(ln.split("=")[1])
 
         self.models = []
         i = 0

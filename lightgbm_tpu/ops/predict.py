@@ -65,3 +65,89 @@ def predict_leaf_raw(split_feature_real: jax.Array, threshold: jax.Array,
 
     node = jax.lax.while_loop(cond, body, node)
     return ~node
+
+
+def split_hi_lo(a: "np.ndarray"):
+    """Order-isomorphic encoding of f64 values as (hi, lo) uint32 pairs.
+
+    The device never needs x64: each double's bit pattern is mapped on
+    the HOST to a uint64 whose unsigned order equals the IEEE-754 total
+    order (negatives bit-flipped, positives sign-bit-set — the classic
+    radix-sortable-float transform), then split into two uint32 words.
+    Lexicographic compare of the pairs reproduces the f64 `<=` EXACTLY
+    for every finite value, ±1e308 (the parser's inf mapping), and
+    subnormals — no precision loss, int ops only on device.  -0.0 is
+    normalized to +0.0 first (IEEE `<=` treats them equal); NaN maps to
+    the largest key, so `value <= threshold` is false and NaN rows take
+    the right child, matching the reference's failed double compare
+    (tree.h:179-189)."""
+    import numpy as np
+    a = np.asarray(a, dtype=np.float64)
+    a = np.where(a == 0.0, 0.0, a)          # -0.0 -> +0.0
+    bits = a.view(np.uint64)
+    neg = bits >> np.uint64(63)
+    key = bits ^ np.where(neg.astype(bool),
+                          np.uint64(0xFFFFFFFFFFFFFFFF),
+                          np.uint64(0x8000000000000000))
+    key = np.where(np.isnan(a), np.uint64(0xFFFFFFFFFFFFFFFF), key)
+    hi = (key >> np.uint64(32)).astype(np.uint32)
+    lo = (key & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def _leaf_hi_lo_inner(split_feature_real, thr_hi, thr_lo, left_child,
+                      right_child, x_hi, x_lo):
+    """One tree's descent for all rows: value <= threshold via exact
+    lexicographic uint32-pair compare of split_hi_lo keys."""
+    n = x_hi.shape[0]
+    rows = jnp.arange(n)
+    node = jnp.zeros(n, dtype=jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        idx = jnp.maximum(node, 0)
+        feat = split_feature_real[idx]
+        vh = x_hi[rows, feat]
+        vl = x_lo[rows, feat]
+        th = thr_hi[idx]
+        tl = thr_lo[idx]
+        left = (vh < th) | ((vh == th) & (vl <= tl))
+        nxt = jnp.where(left, left_child[idx], right_child[idx])
+        return jnp.where(node >= 0, nxt, node)
+
+    return ~jax.lax.while_loop(cond, body, node)
+
+
+@jax.jit
+def predict_leaf_stacked(split_feature_real: jax.Array, thr_hi: jax.Array,
+                         thr_lo: jax.Array, left_child: jax.Array,
+                         right_child: jax.Array, x_hi: jax.Array,
+                         x_lo: jax.Array) -> jax.Array:
+    """Whole-model leaf indices on device.
+
+    The reference predicts row-by-row, tree-by-tree on the host
+    (predictor.hpp:35-70 over Tree::GetLeaf, tree.h:179-189); here every
+    tree's node arrays are stacked into [T, M] tensors and a lax.scan
+    walks the model while all rows descend each tree data-parallel on
+    the VPU.  Only the traversal runs on device — score accumulation
+    happens on the host in f64 from the returned indices (gbdt.py
+    predict_raw), keeping output formatting byte-identical to the
+    reference under any backend/x64 configuration.
+
+    split_feature_real/thr_hi/thr_lo/left_child/right_child: [T, M]
+    padded node arrays (a 1-leaf stump encodes left_child[0] == ~0 so
+    every row lands in leaf 0); x_hi/x_lo: [C, F_total] f32 pair.
+    Returns [C, T] i32 leaf indices.
+    """
+
+    def per_tree(_, t):
+        sf_t, th_t, tl_t, lc_t, rc_t = t
+        return None, _leaf_hi_lo_inner(sf_t, th_t, tl_t, lc_t, rc_t,
+                                       x_hi, x_lo)
+
+    _, leaves = jax.lax.scan(
+        per_tree, None,
+        (split_feature_real, thr_hi, thr_lo, left_child, right_child))
+    return leaves.T

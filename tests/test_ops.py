@@ -291,3 +291,71 @@ def test_hist_pool_wide_shape():
     counts = np.bincount(np.asarray(leaf_id), minlength=nl)
     np.testing.assert_array_equal(counts[:nl],
                                   np.asarray(tree.leaf_count)[:nl])
+
+
+def test_split_hi_lo_total_order():
+    """The uint32-pair key must reproduce the f64 <= compare EXACTLY for
+    extremes the old Dekker float split collapsed: +-1e308 (the parser's
+    inf mapping), sub-f32-range magnitudes, signed zeros, NaN."""
+    from lightgbm_tpu.ops.predict import split_hi_lo
+
+    vals = np.array([-np.inf, -1e308, -5e307, -3.4e38, -1.857, -1e-300,
+                     -0.0, 0.0, 1e-300, 2e-300, 1.457, 1.4569999999999999,
+                     3.4e38, 5e307, 1e308, np.inf])
+    h, lo = split_hi_lo(vals)
+    for i, a in enumerate(vals):
+        for j, b in enumerate(vals):
+            lex = bool((h[i] < h[j]) | ((h[i] == h[j]) & (lo[i] <= lo[j])))
+            assert lex == (a <= b), (a, b)
+    # NaN routes right: value <= threshold false against every threshold
+    nh, nl = split_hi_lo(np.array([np.nan]))
+    for j in range(len(vals)):
+        assert not bool((nh[0] < h[j]) | ((nh[0] == h[j]) & (nl[0] <= lo[j])))
+
+
+def test_predict_extreme_values_match_host_traversal():
+    """Device stacked traversal == per-tree host numpy traversal on data
+    containing +-1e308 / tiny / NaN-free extremes (predictor parity for
+    the inf -> +-1e308 Atof mapping)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.binning import find_bins
+    from lightgbm_tpu.io.dataset import Dataset, Metadata
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(11)
+    n, f = 600, 6
+    x = rng.randn(n, f)
+    x[rng.rand(n) < 0.05] *= 1e305           # huge magnitudes
+    x[rng.rand(n) < 0.05] *= 1e-300          # tiny magnitudes
+    y = (x[:, 0] > 0).astype(np.float64)
+    cfg = Config.from_params({"objective": "binary", "num_trees": "5",
+                              "num_leaves": "7", "min_data_in_leaf": "5"})
+    mappers = find_bins(x, n, cfg.max_bin)
+    bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
+                     for j, m in enumerate(mappers)])
+    ds = Dataset(bins=bins, bin_mappers=mappers,
+                 used_feature_map=np.arange(f, dtype=np.int32),
+                 real_feature_index=np.arange(f, dtype=np.int32),
+                 num_total_features=f,
+                 feature_names=["Column_%d" % i for i in range(f)],
+                 metadata=Metadata(label=y))
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, n)
+    booster = create_boosting(cfg, ds, obj)
+    for _ in range(5):
+        booster.train_one_iter(None, None, False)
+
+    xt = rng.randn(200, f)
+    xt[::7] *= 1e305
+    xt[::11] *= 1e-300
+    got = booster.predict_raw(xt)
+    want = np.zeros_like(got)
+    for i, tree in enumerate(booster.models[:booster.num_used_model]):
+        want[i % booster.num_class] += tree.predict(xt)
+    np.testing.assert_array_equal(got, want)
+    # narrow matrix: missing trailing features read as 0.0, not clamped
+    narrow = xt[:, :3]
+    wide = np.pad(narrow, ((0, 0), (0, f - 3)))
+    np.testing.assert_array_equal(booster.predict_raw(narrow),
+                                  booster.predict_raw(wide))
