@@ -51,16 +51,22 @@ class Application:
         cfg = self.config
         # multi-host: bring up the JAX distributed runtime from the
         # machine list (replaces Network::Init, application.cpp:185).
-        # Each process loads its row shard (query-granular for ranking)
-        # and device placement goes through make_array_from_process_local
-        # _data (parallel/mesh.py _put_sharded).  NOTE: objectives and
-        # metrics currently reduce over process-LOCAL rows only — global
-        # label statistics / metric reductions across hosts are not wired
-        # yet, so multi-host training is experimental.
+        # Each process loads its row shard (query-granular for ranking;
+        # valid files shard the same way), device placement goes through
+        # make_array_from_process_local_data (parallel/mesh.py
+        # _put_sharded), metrics allreduce partial sums so every rank
+        # reports GLOBAL values, and the early-stop decision is
+        # OR-allreduced across ranks.  Seeds/feature_fraction sync by
+        # min and a config fingerprint check rejects inconsistent
+        # per-rank hyper-parameters (GlobalSyncUpByMin,
+        # application.cpp:119,188-193,255-282).
         self.rank, self.num_machines = 0, 1
         if cfg.num_machines > 1:
-            from .parallel.dist import init_distributed
+            from .parallel.dist import (check_config_fingerprint,
+                                        init_distributed, sync_config_by_min)
             self.rank, self.num_machines = init_distributed(cfg)
+            sync_config_by_min(cfg)
+            check_config_fingerprint(cfg)
         self.boosting_old: Optional[GBDT] = None
         if cfg.input_model:
             # continued training (application.cpp:106-180): predict init
@@ -75,21 +81,33 @@ class Application:
                                        num_shards=self.num_machines)
         if self.boosting_old is not None:
             self._set_init_scores(self.train_data, cfg.data)
+        reducers = None
+        if self.num_machines > 1:
+            from .parallel.dist import make_metric_reducer
+            reducers = make_metric_reducer()
+
         self.train_metrics = []
         for m in create_metrics(cfg):
             m.init("training", self.train_data.metadata,
                    self.train_data.num_data)
+            if reducers is not None:
+                m.set_reducer(*reducers)
             self.train_metrics.append(m)
 
         self.valid_datas: List[Dataset] = []
         self.valid_metricss: List[List[Metric]] = []
         for fname in cfg.valid_data:
-            vd = load_dataset(fname, cfg, reference=self.train_data)
+            # multi-host: valid files shard per rank like the train file;
+            # metric reduction makes the reported values global
+            vd = load_dataset(fname, cfg, reference=self.train_data,
+                              rank=self.rank, num_shards=self.num_machines)
             if self.boosting_old is not None:
                 self._set_init_scores(vd, fname)
             ms = []
             for m in create_metrics(cfg):
                 m.init(fname, vd.metadata, vd.num_data)
+                if reducers is not None:
+                    m.set_reducer(*reducers)
                 ms.append(m)
             self.valid_datas.append(vd)
             self.valid_metricss.append(ms)
@@ -109,6 +127,14 @@ class Application:
                 len(self.boosting.models) // cfg.num_class)
         for vd, ms in zip(self.valid_datas, self.valid_metricss):
             self.boosting.add_valid_data(vd, ms)
+        if self.num_machines > 1:
+            from .parallel.dist import process_allgather
+
+            def stop_sync(b: bool) -> bool:
+                votes = process_allgather(np.array([int(b)], dtype=np.int64))
+                return bool(votes.sum() > 0)
+
+            self.boosting.stop_sync = stop_sync
         log.info("Finished initializing training")
 
     def _set_init_scores(self, ds: Dataset, fname: str) -> None:
@@ -117,7 +143,12 @@ class Application:
         if self.config.has_header:
             lines = lines[1:]
         _, feats, _ = parse_file_lines(lines, ds.label_idx)
-        raw = self.boosting_old.predict_raw(feats)   # [K, N]
+        raw = self.boosting_old.predict_raw(feats)   # [K, N_total]
+        if ds.local_rows is not None:
+            # rank-sharded dataset: keep this rank's rows so the init
+            # scores align with the local shard (add_valid_data's size
+            # check would otherwise silently drop them)
+            raw = raw[:, ds.local_rows]
         ds.metadata.init_score = raw.reshape(-1).astype(np.float64)
 
     def train(self) -> None:
@@ -193,17 +224,26 @@ class Application:
                 yield buf
 
         fmt = [None]
+        width = [None]
 
         def parse(lines):
             _, feats, f = parse_file_lines(lines, label_idx, fmt[0])
             fmt[0] = f  # sniff once, reuse for every later block
-            if feats.shape[1] < n_total_feat:  # short rows (e.g. libsvm)
-                feats = np.pad(feats,
-                               ((0, 0), (0, n_total_feat - feats.shape[1])))
-            elif feats.shape[1] > n_total_feat:
+            if width[0] is None:
+                # the FILE's first row fixes the column count, exactly as
+                # the whole-file parse did (later ragged/libsvm blocks
+                # must not widen or narrow the matrix)
+                width[0] = feats.shape[1]
+            w = width[0]
+            if feats.shape[1] < w:
+                feats = np.pad(feats, ((0, 0), (0, w - feats.shape[1])))
+            elif feats.shape[1] > w:
+                feats = feats[:, :w]
+            if w > n_total_feat:
                 # columns past the model's max_feature_idx are never read
                 # by any tree; one stable width keeps one compiled
-                # traversal executable across blocks
+                # traversal executable across blocks (missing trailing
+                # features are zero-padded inside the predictor)
                 feats = feats[:, :n_total_feat]
             return feats
 

@@ -68,6 +68,10 @@ class Dataset:
     feature_names: List[str]
     metadata: Metadata
     label_idx: int = 0
+    # multi-host row sharding: GLOBAL indices of the rows this rank kept
+    # (None = unsharded).  Lets callers align whole-file artifacts (e.g.
+    # continued-training init scores) with the local shard.
+    local_rows: "Optional[np.ndarray]" = None
 
     @property
     def num_data(self) -> int:
@@ -407,11 +411,13 @@ def _load_two_round(filename: str, config: Config, rank: int,
             [[0], np.cumsum(q.astype(np.int64))]).astype(np.int32)
         log.info("Loading query boundaries...")
     init = _load_sidecar(filename + ".init")
+    local_rows = None
     if sharding:
         if q is not None:
             log.fatal("two_round loading cannot shard ranking data by "
                       "query; use use_two_round_loading=false")
         keep = np.arange(n_total) % num_shards == rank
+        local_rows = np.nonzero(keep)[0].astype(np.int64)
         if w is not None:
             weights = weights[keep]
         if init is not None:
@@ -432,7 +438,8 @@ def _load_two_round(filename: str, config: Config, rank: int,
                  used_feature_map=used_feature_map,
                  real_feature_index=np.asarray(real_index, dtype=np.int32),
                  num_total_features=ncols, feature_names=names,
-                 metadata=metadata, label_idx=label_idx)
+                 metadata=metadata, label_idx=label_idx,
+                 local_rows=local_rows)
     log.info("Finished loading data file, use %d features with %d data"
              % (ds.num_features, ds.num_data))
     if config.is_save_binary_file and num_shards == 1:
@@ -538,6 +545,7 @@ def load_dataset(filename: str, config: Config,
     # info exists (the reference partitions query-granularly,
     # dataset_loader.cpp:467-572); labels, features and ALL metadata
     # shard with the same mask (Metadata::CheckOrPartition)
+    local_rows = None
     if num_shards > 1 and not config.is_pre_partition:
         if query_boundaries is not None:
             nq = len(query_boundaries) - 1
@@ -548,6 +556,7 @@ def load_dataset(filename: str, config: Config,
                 [[0], np.cumsum(qcounts[qsel])]).astype(np.int32)
         else:
             keep = np.arange(n_total) % num_shards == rank
+        local_rows = np.nonzero(keep)[0].astype(np.int64)
         label, feats = label[keep], feats[keep]
         if weights is not None:
             weights = weights[keep]
@@ -579,7 +588,8 @@ def load_dataset(filename: str, config: Config,
             real_feature_index=reference.real_feature_index,
             num_total_features=reference.num_total_features,
             feature_names=reference.feature_names,
-            metadata=metadata, label_idx=label_idx)
+            metadata=metadata, label_idx=label_idx,
+            local_rows=local_rows)
         ds.bins = ds.bin_feature_values(feats)
         return ds
 

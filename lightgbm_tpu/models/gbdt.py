@@ -388,7 +388,10 @@ class GBDT:
         self.num_used_model = len(self._models) // self.num_class
         custom_grads = gradients is not None
         if is_eval or custom_grads or self.iter % self._flush_every == 0:
-            if self._flush_pending():
+            # multi-host: the stump stop must be OR-synced here too (this
+            # flush runs BEFORE eval_and_check's, so a lone rank stopping
+            # would leave the others blocked in their next collective)
+            if self._sync_stop(self._flush_pending()):
                 log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
                 return True
@@ -630,16 +633,27 @@ class GBDT:
         return s[0] if self.num_class == 1 else s
 
     # ------------------------------------------------------------------
+    # multi-host: cli.init_train installs an OR-allreduce here so every
+    # rank takes the same stop decision — a rank stopping alone would
+    # deadlock the others' next SPMD collective (metrics are already
+    # globally reduced, so decisions agree; this is the hard guarantee)
+    stop_sync = None
+
+    def _sync_stop(self, stop: bool) -> bool:
+        if self.stop_sync is not None:
+            return bool(self.stop_sync(bool(stop)))
+        return stop
+
     def eval_and_check_early_stopping(self) -> bool:
         # Flush BEFORE evaluating: if a pending 1-leaf stump stopped
         # training, that stop wins — evaluating or popping trees off the
         # truncated model would corrupt it (the reference never reaches
         # its early-stopping path after the stump stop, gbdt.cpp:186).
-        if self._flush_pending():
+        if self._sync_stop(self._flush_pending()):
             log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")
             return True
-        stop = self.output_metric(self.iter)
+        stop = self._sync_stop(self.output_metric(self.iter))
         if stop:
             log.info("Early stopping at iteration %d, the best iteration "
                      "round is %d" % (self.iter,
@@ -745,11 +759,20 @@ class GBDT:
         n = x.shape[0]
         out = np.empty((n, nmodels), dtype=np.int64)
         for a in range(0, n, self.PREDICT_CHUNK):
-            xh, xl = split_hi_lo(
-                np.ascontiguousarray(x[a:a + self.PREDICT_CHUNK]))
-            out[a:a + self.PREDICT_CHUNK] = np.asarray(
+            chunk = np.ascontiguousarray(x[a:a + self.PREDICT_CHUNK])
+            # pad rows up to a power-of-two bucket: one compiled traversal
+            # per bucket instead of one per distinct batch size
+            rows = chunk.shape[0]
+            bucket = 256
+            while bucket < rows:
+                bucket <<= 1
+            if bucket > rows:
+                chunk = np.pad(chunk, ((0, bucket - rows), (0, 0)))
+            xh, xl = split_hi_lo(chunk)
+            leaves = np.asarray(
                 predict_leaf_stacked(*dev, jnp.asarray(xh),
                                      jnp.asarray(xl)))
+            out[a:a + self.PREDICT_CHUNK] = leaves[:rows]
         return out
 
     def predict_raw(self, x: np.ndarray) -> np.ndarray:

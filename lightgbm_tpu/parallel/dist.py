@@ -102,3 +102,71 @@ def process_allgather(array: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(array))
+
+
+def process_concat(array: np.ndarray) -> np.ndarray:
+    """Concatenate per-process host arrays of DIFFERENT leading lengths
+    along axis 0 (rank order).  process_allgather needs equal shapes, so
+    lengths are gathered first and data is padded to the max."""
+    array = np.ascontiguousarray(array)
+    lens = process_allgather(np.array([array.shape[0]], dtype=np.int64))
+    lens = lens.reshape(-1)
+    mx = int(lens.max())
+    pad = np.zeros((mx,) + array.shape[1:], dtype=array.dtype)
+    pad[:array.shape[0]] = array
+    stacked = process_allgather(pad)          # [P, mx, ...]
+    return np.concatenate([stacked[p, :int(lens[p])]
+                           for p in range(stacked.shape[0])], axis=0)
+
+
+def sync_config_by_min(config) -> None:
+    """The reference's GlobalSyncUpByMin (application.cpp:119,188-193 +
+    255-282): allreduce-min the RNG seeds and feature_fraction so ranks
+    with inconsistent configs cannot silently grow different trees.
+    Mutates config in place on every rank to the global minimum."""
+    vals = np.array([config.feature_fraction_seed,
+                     config.data_random_seed,
+                     config.bagging_seed,
+                     config.drop_seed], dtype=np.int64)
+    frac = np.array([config.feature_fraction], dtype=np.float64)
+    gi = process_allgather(vals).min(axis=0)
+    gf = process_allgather(frac).min(axis=0)
+    config.feature_fraction_seed = int(gi[0])
+    config.data_random_seed = int(gi[1])
+    config.bagging_seed = int(gi[2])
+    config.drop_seed = int(gi[3])
+    config.feature_fraction = float(gf[0])
+
+
+def check_config_fingerprint(config) -> None:
+    """Fatal when ranks disagree on any tree-shaping hyper-parameter —
+    the silent-divergence class GlobalSyncUpByMin cannot repair.  The
+    fingerprint covers everything that shapes the SPMD computation;
+    paths/ports that legitimately differ per rank are excluded."""
+    import hashlib
+    keys = ("objective", "boosting_type", "tree_learner", "num_class",
+            "num_iterations", "num_leaves", "max_depth", "max_bin",
+            "min_data_in_leaf", "min_sum_hessian_in_leaf", "learning_rate",
+            "lambda_l1", "lambda_l2", "min_gain_to_split",
+            "feature_fraction", "feature_fraction_seed", "bagging_fraction",
+            "bagging_freq", "bagging_seed", "early_stopping_round",
+            "metric", "metric_freq", "hist_dtype", "hist_impl", "hist_agg",
+            "num_shards", "top_k", "drop_rate", "drop_seed", "sigmoid",
+            "num_machines")
+    desc = ";".join("%s=%r" % (k, getattr(config, k, None)) for k in keys)
+    h = np.frombuffer(hashlib.sha256(desc.encode()).digest()[:8],
+                      dtype=np.int64)
+    all_h = process_allgather(h).reshape(-1)
+    if not (all_h == all_h[0]).all():
+        log.fatal("Inconsistent training configs across machines "
+                  "(config fingerprints differ); every rank must use "
+                  "identical hyper-parameters: %s" % desc)
+
+
+def make_metric_reducer():
+    """(sum_reduce, concat) callables for Metric.set_reducer: partial
+    metric sums allreduce across ranks; order-sensitive metrics (AUC)
+    concatenate raw columns instead."""
+    return (lambda parts: process_allgather(
+                np.asarray(parts, dtype=np.float64)).sum(axis=0),
+            process_concat)

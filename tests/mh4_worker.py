@@ -1,0 +1,61 @@
+"""Worker for the 4-process x 2-device multi-host CLI test
+(test_parallel.py::test_multihost_four_process_cli).
+
+Drives the REAL cli.Application surface: machine_list_file bootstrap,
+GlobalSyncUpByMin seed sync (each rank passes a DIFFERENT
+feature_fraction_seed — training must still produce identical models),
+rank-sharded valid data with globally-reduced metrics, and the
+OR-allreduced early-stop decision.
+
+Usage: python mh4_worker.py <rank> <nproc> <machine_list> <listen_port>
+                            <data> <valid> <model_out> <log_out>
+"""
+
+import os
+import sys
+
+(rank, nproc, mlist, port, data, valid, out, log_out) = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], sys.argv[7], sys.argv[8])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from lightgbm_tpu import cli  # noqa: E402
+from lightgbm_tpu.utils import log as log_mod  # noqa: E402
+
+lines = []
+orig_info = log_mod.info
+
+
+def capture_info(msg):
+    lines.append(str(msg))
+    orig_info(msg)
+
+
+log_mod.info = capture_info
+
+app = cli.Application([
+    "task=train", "data=" + data, "valid_data=" + valid,
+    "objective=binary", "tree_learner=data", "num_machines=%d" % nproc,
+    "machine_list_file=" + mlist, "local_listen_port=" + port,
+    "num_trees=30", "num_leaves=8", "min_data_in_leaf=5",
+    "min_sum_hessian_in_leaf=1", "hist_dtype=float64",
+    "metric=binary_logloss,auc", "metric_freq=1",
+    "early_stopping_round=2", "is_save_binary_file=false",
+    # deliberately rank-dependent: GlobalSyncUpByMin must reconcile it
+    "feature_fraction=0.8", "feature_fraction_seed=%d" % (7 + rank),
+    "output_model=" + out,
+])
+app.run()
+
+with open(log_out, "w") as f:
+    f.write("\n".join(ln for ln in lines if "Iteration" in ln
+                      or "Early stopping" in ln) + "\n")
+print("worker %d done" % rank)

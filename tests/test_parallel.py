@@ -462,7 +462,10 @@ def test_multihost_two_process_training(tmp_path):
         "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
         "hist_dtype": "float64", "metric": "",
         "is_save_binary_file": "false"})
-    xf = np.asarray([[float("%f" % v) for v in row] for row in x])
+    # parse exactly as the workers' loader does (reference Atof digit
+    # arithmetic, NOT correctly-rounded float())
+    from lightgbm_tpu.io.parser import _clean_token
+    xf = np.asarray([[_clean_token("%f" % v) for v in row] for row in x])
     mappers = []
     for r, sl in enumerate(feature_slices(ncol, 2)):
         xr = xf[np.arange(n) % 2 == r]
@@ -554,3 +557,77 @@ def test_multihost_matches_reference_socket_cluster(tmp_path):
             b = np.array(want[key].split(), dtype=np.float64)
             np.testing.assert_allclose(a, b, rtol=5e-6,
                                        err_msg="tree %d %s" % (i, key))
+
+
+@pytest.mark.slow
+def test_multihost_four_process_cli(tmp_path):
+    """4 jax processes x 2 virtual CPU devices drive the REAL CLI
+    (machine_list_file bootstrap) end-to-end: ranks pass DIFFERENT
+    feature_fraction_seeds (GlobalSyncUpByMin must reconcile them to the
+    minimum), valid data is rank-sharded with metrics allreduced to
+    global values, and the early-stop decision is OR-synced.  All four
+    ranks must emit byte-identical models AND byte-identical
+    per-iteration metric lines, and stop at the same iteration."""
+    import os
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    nproc = 4
+    rng = np.random.RandomState(5)
+    n, nv, ncol = 800, 400, 6
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.3 * x[:, 1] + 0.7 * rng.randn(n) > 0).astype(int)
+    xv = rng.randn(nv, ncol)
+    yv = (xv[:, 0] + 0.3 * xv[:, 1] + 0.7 * rng.randn(nv) > 0).astype(int)
+
+    def write_tsv(path, xx, yy):
+        path.write_text("\n".join(
+            "\t".join([str(yy[i])] + ["%f" % v for v in xx[i]])
+            for i in range(len(yy))) + "\n")
+
+    data = tmp_path / "train.tsv"
+    valid = tmp_path / "valid.tsv"
+    write_tsv(data, x, y)
+    write_tsv(valid, xv, yv)
+
+    ports = []
+    socks = []
+    for _ in range(nproc):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        ports.append(str(s.getsockname()[1]))
+        socks.append(s)
+    for s in socks:
+        s.close()
+    mlist = tmp_path / "machines.txt"
+    mlist.write_text("".join("127.0.0.1 %s\n" % p for p in ports))
+
+    outs = [str(tmp_path / ("model_%d.txt" % r)) for r in range(nproc)]
+    logs_f = [str(tmp_path / ("log_%d.txt" % r)) for r in range(nproc)]
+    worker = os.path.join(os.path.dirname(__file__), "mh4_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), str(nproc), str(mlist), ports[r],
+         str(data), str(valid), outs[r], logs_f[r]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(nproc)]
+    outputs = [p.communicate(timeout=900)[0].decode() for p in procs]
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, outputs[r])
+
+    models = [open(o).read() for o in outs]
+    for r in range(1, nproc):
+        assert models[r] == models[0], \
+            "rank %d saved a different model" % r
+    # per-iteration metric lines globally reduced -> identical per rank
+    metric_logs = [open(f).read() for f in logs_f]
+    for r in range(1, nproc):
+        assert metric_logs[r] == metric_logs[0], \
+            "rank %d reported different metrics:\n%s\nvs\n%s" % (
+                r, metric_logs[r], metric_logs[0])
+    # the deliberately-noisy data must actually trigger early stopping,
+    # proving the stop path (incl. the OR-sync) executed
+    assert "Early stopping" in metric_logs[0]
+    assert models[0].count("Tree=") < 30
