@@ -143,12 +143,13 @@ class Application:
         if self.config.has_header:
             lines = lines[1:]
         _, feats, _ = parse_file_lines(lines, ds.label_idx)
-        raw = self.boosting_old.predict_raw(feats)   # [K, N_total]
         if ds.local_rows is not None:
-            # rank-sharded dataset: keep this rank's rows so the init
-            # scores align with the local shard (add_valid_data's size
-            # check would otherwise silently drop them)
-            raw = raw[:, ds.local_rows]
+            # rank-sharded dataset: predict only this rank's rows so the
+            # init scores align with the local shard at 1/P the traversal
+            # cost (add_valid_data's size check would otherwise silently
+            # drop them)
+            feats = feats[ds.local_rows]
+        raw = self.boosting_old.predict_raw(feats)   # [K, N_local]
         ds.metadata.init_score = raw.reshape(-1).astype(np.float64)
 
     def train(self) -> None:
@@ -229,21 +230,27 @@ class Application:
         def parse(lines):
             _, feats, f = parse_file_lines(lines, label_idx, fmt[0])
             fmt[0] = f  # sniff once, reuse for every later block
-            if width[0] is None:
-                # the FILE's first row fixes the column count, exactly as
-                # the whole-file parse did (later ragged/libsvm blocks
-                # must not widen or narrow the matrix)
-                width[0] = feats.shape[1]
-            w = width[0]
-            if feats.shape[1] < w:
-                feats = np.pad(feats, ((0, 0), (0, w - feats.shape[1])))
-            elif feats.shape[1] > w:
-                feats = feats[:, :w]
-            if w > n_total_feat:
-                # columns past the model's max_feature_idx are never read
-                # by any tree; one stable width keeps one compiled
-                # traversal executable across blocks (missing trailing
-                # features are zero-padded inside the predictor)
+            if f != "libsvm":
+                # dense: the FILE's first row fixes the column count,
+                # exactly as the whole-file parse did — later ragged rows
+                # truncate / zero-fill to it
+                if width[0] is None:
+                    width[0] = feats.shape[1]
+                w = width[0]
+                if feats.shape[1] < w:
+                    feats = np.pad(feats,
+                                   ((0, 0), (0, w - feats.shape[1])))
+                elif feats.shape[1] > w:
+                    feats = feats[:, :w]
+            # normalize every block to the MODEL's width: libsvm blocks
+            # vary with their own max index (must not cap later blocks at
+            # the first block's), columns past max_feature_idx are never
+            # read by any tree, and one stable width keeps one compiled
+            # traversal executable across blocks
+            if feats.shape[1] < n_total_feat:
+                feats = np.pad(
+                    feats, ((0, 0), (0, n_total_feat - feats.shape[1])))
+            elif feats.shape[1] > n_total_feat:
                 feats = feats[:, :n_total_feat]
             return feats
 

@@ -162,7 +162,10 @@ class AUCMetric(Metric):
             # unlike the sum-decomposable losses, partial AUCs don't add
             cols = self.concat(np.stack([s, label, w], axis=1))
             s, label, w = cols[:, 0], cols[:, 1], cols[:, 2]
-            sum_w = self._reduce(self.sum_weights)[0]
+            # the gathered weight column already carries the global sum
+            # (and sums in the same order sum_pos accumulates, so the
+            # all-positive == test below stays exact)
+            sum_w = float(w.sum())
         order = np.argsort(-s, kind="stable")
         s, label, w = s[order], label[order], w[order]
         pos = label * w

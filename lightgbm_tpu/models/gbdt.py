@@ -160,6 +160,15 @@ class GBDT:
                                  and self.dtype == jnp.float32
                                  and train_data.bins.dtype == np.uint8)
                     else "xla")
+            if on_accel and impl == "xla":
+                # not silent: the parity configuration (hist_dtype=
+                # float64) or wide bins forfeit the Pallas fast path
+                log.warning(
+                    "Histogram fast path (Pallas) disabled on this "
+                    "accelerator (max_bin=%d, hist_dtype=%s, bins dtype "
+                    "%s); using the slower XLA one-hot path"
+                    % (self.max_bin, config.hist_dtype,
+                       train_data.bins.dtype))
         self.hist_impl = impl
         row_unit = 1
         if impl == "pallas":
@@ -387,10 +396,14 @@ class GBDT:
         self.iter += 1
         self.num_used_model = len(self._models) // self.num_class
         custom_grads = gradients is not None
-        if is_eval or custom_grads or self.iter % self._flush_every == 0:
-            # multi-host: the stump stop must be OR-synced here too (this
-            # flush runs BEFORE eval_and_check's, so a lone rank stopping
-            # would leave the others blocked in their next collective)
+        if (custom_grads or self.iter % self._flush_every == 0) \
+                and not is_eval:
+            # multi-host: the stump stop must be OR-synced on the
+            # non-eval flush paths too — a lone rank stopping would
+            # leave the others blocked in their next collective.  The
+            # eval path defers to eval_and_check_early_stopping, which
+            # flushes (and syncs) first thing, so the collective runs
+            # exactly once per iteration.
             if self._sync_stop(self._flush_pending()):
                 log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")

@@ -152,8 +152,11 @@ def check_config_fingerprint(config) -> None:
             "bagging_freq", "bagging_seed", "early_stopping_round",
             "metric", "metric_freq", "hist_dtype", "hist_impl", "hist_agg",
             "num_shards", "top_k", "drop_rate", "drop_seed", "sigmoid",
-            "num_machines")
+            "num_machines", "is_training_metric")
     desc = ";".join("%s=%r" % (k, getattr(config, k, None)) for k in keys)
+    # the number of valid sets shapes the per-eval collective schedule
+    # (each metric eval allreduces): ranks must agree on it too
+    desc += ";num_valid=%d" % len(getattr(config, "valid_data", []) or [])
     h = np.frombuffer(hashlib.sha256(desc.encode()).digest()[:8],
                       dtype=np.int64)
     all_h = process_allgather(h).reshape(-1)

@@ -1,0 +1,91 @@
+"""Worker for wide virtual-mesh scaling tests
+(test_parallel.py::test_wide_mesh_tree_identity).
+
+Runs in a fresh process so the virtual CPU device count can exceed the
+suite-wide 8 (xla_force_host_platform_device_count is fixed at backend
+init).  Checks, at N devices:
+
+  - data-parallel tree identity vs the serial grower, hist_agg=psum
+  - the same under the owner-computes scatter protocol (hist_agg=scatter)
+  - voting-parallel (PV-Tree) == data-parallel when top-k covers all
+    features
+
+Usage: python mesh_worker.py <ndev>
+"""
+
+import os
+import sys
+
+ndev = int(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=%d"
+                           % ndev)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+assert len(jax.devices()) == ndev
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lightgbm_tpu.ops.grow import grow_tree  # noqa: E402
+from lightgbm_tpu.ops.split import SplitParams  # noqa: E402
+from lightgbm_tpu.parallel.mesh import (  # noqa: E402
+    ShardedGrower, make_mesh, padded_size)
+
+PARAMS = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+                     lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0)
+
+rng = np.random.RandomState(17)
+n = 40 * ndev + 3          # non-divisible: exercises padding
+f = 8
+bins_t = rng.randint(0, 32, size=(f, n)).astype(np.uint8)
+grad = rng.randn(n).astype(np.float64)
+hess = (rng.rand(n) + 0.5).astype(np.float64)
+
+serial_tree, serial_leaf = grow_tree(
+    jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+    jnp.ones(n, dtype=bool), jnp.ones(f, dtype=bool),
+    max_leaves=15, max_bin=32, params=PARAMS)
+nl = int(serial_tree.num_leaves)
+
+mesh = make_mesh(ndev)
+n_pad = padded_size(n, ndev)
+pad = n_pad - n
+
+
+def grow_with(**kw):
+    grower = ShardedGrower(mesh, max_leaves=15, max_bin=32, params=PARAMS,
+                           **kw)
+    tree, leaf = grower.grow(
+        grower.shard_bins(bins_t),
+        grower.shard_rows(np.pad(grad, (0, pad)), n_pad),
+        grower.shard_rows(np.pad(hess, (0, pad)), n_pad),
+        grower.shard_rows(np.pad(np.ones(n, dtype=bool), (0, pad)), n_pad),
+        jnp.ones(f, dtype=bool))
+    return tree, leaf
+
+
+for label, kw in (("psum", dict(hist_agg="psum")),
+                  ("scatter", dict(hist_agg="scatter")),
+                  ("voting", dict(voting_top_k=f))):
+    tree, leaf = grow_with(**kw)
+    assert int(tree.num_leaves) == nl, (label, int(tree.num_leaves), nl)
+    np.testing.assert_array_equal(
+        np.asarray(tree.split_feature)[:nl - 1],
+        np.asarray(serial_tree.split_feature)[:nl - 1], err_msg=label)
+    np.testing.assert_array_equal(
+        np.asarray(tree.threshold_bin)[:nl - 1],
+        np.asarray(serial_tree.threshold_bin)[:nl - 1], err_msg=label)
+    np.testing.assert_allclose(
+        np.asarray(tree.leaf_value)[:nl],
+        np.asarray(serial_tree.leaf_value)[:nl], rtol=1e-9, err_msg=label)
+    np.testing.assert_array_equal(np.asarray(leaf)[:n],
+                                  np.asarray(serial_leaf), err_msg=label)
+    print("%s ok at %d devices (%d leaves)" % (label, ndev, nl))
+
+print("MESH_WORKER_OK %d" % ndev)

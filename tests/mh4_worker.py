@@ -48,6 +48,7 @@ app = cli.Application([
     "num_trees=30", "num_leaves=8", "min_data_in_leaf=5",
     "min_sum_hessian_in_leaf=1", "hist_dtype=float64",
     "metric=binary_logloss,auc", "metric_freq=1",
+    "is_training_metric=true",
     "early_stopping_round=2", "is_save_binary_file=false",
     # deliberately rank-dependent: GlobalSyncUpByMin must reconcile it
     "feature_fraction=0.8", "feature_fraction_seed=%d" % (7 + rank),
