@@ -237,11 +237,30 @@ def _load_two_round(filename: str, config: Config, rank: int,
     SampleFromFile).  The structural template for out-of-core-scale
     ingest: peak memory is one chunk of floats + the binned matrix.
 
-    Row sharding is modulo only; ranking data (query-granular sharding)
-    must use one-round loading."""
+    Row sharding is modulo, or query-granular when a .query sidecar is
+    present (whole queries stay on one rank, like one-round loading);
+    ranking data declared via group_column still needs one-round loading
+    (the query ids would have to be parsed during round 1's raw-line
+    scan)."""
     sample_target = max(1, config.bin_construct_sample_cnt)
     rng = np.random.RandomState(config.data_random_seed)
     sharding = num_shards > 1 and not config.is_pre_partition
+
+    # query-granular sharding from the .query sidecar: global row ->
+    # owning rank via the query index (reference partitions query-
+    # granularly, dataset_loader.cpp:467-572)
+    qcounts_all = qb_global = None
+    if sharding:
+        qraw = _load_sidecar(filename + ".query")
+        if qraw is not None:
+            qcounts_all = qraw.astype(np.int64)
+            qb_global = np.concatenate([[0], np.cumsum(qcounts_all)])
+
+    def shard_sel(gidx: np.ndarray) -> np.ndarray:
+        if qb_global is not None:
+            qi = np.searchsorted(qb_global, gidx, side="right") - 1
+            return (qi % num_shards) == rank
+        return (gidx % num_shards) == rank
 
     # ---- round 1: count rows, reservoir-sample lines ----
     # block reservoir: assign each line a random key, keep the S smallest
@@ -273,7 +292,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
                 # (shard first, then draw the bin sample from local rows)
                 gidx = np.arange(n_total, n_total + len(lines))
                 n_total += len(lines)
-                sel = (gidx % num_shards) == rank
+                sel = shard_sel(gidx)
                 lines = [ln for ln, s in zip(lines, sel) if s]
                 if not lines:
                     continue
@@ -352,11 +371,23 @@ def _load_two_round(filename: str, config: Config, rank: int,
         mappers_all, names)
     if not bin_mappers:
         log.fatal("No usable features in data file %s" % filename)
+    # round-1 artifacts (reservoir lines + parsed sample floats) are tens
+    # of MB at default sample counts — free them so round 2's peak RSS is
+    # one chunk + the uint8 bins, the whole point of two-round loading
+    del kept, keys, sample_raw, sample_feats
 
     # ---- round 2: parse + quantize chunk by chunk ----
-    n_local = (n_total // num_shards
-               + (1 if rank < n_total % num_shards else 0)
-               if sharding else n_total)
+    if not sharding:
+        n_local = n_total
+    elif qb_global is not None:
+        if int(qb_global[-1]) != n_total:
+            log.fatal("Query sizes (%d) do not sum to data count (%d)"
+                      % (int(qb_global[-1]), n_total))
+        qsel_mask = (np.arange(len(qcounts_all)) % num_shards) == rank
+        n_local = int(qcounts_all[qsel_mask].sum())
+    else:
+        n_local = (n_total // num_shards
+                   + (1 if rank < n_total % num_shards else 0))
     max_bin_used = max(m.num_bin for m in bin_mappers)
     dtype = np.uint8 if max_bin_used <= 256 else np.uint16
     bins = np.zeros((len(bin_mappers), n_local), dtype=dtype)
@@ -367,7 +398,9 @@ def _load_two_round(filename: str, config: Config, rank: int,
     out0 = 0   # local write position
     with open(filename, "rb") as f:
         _skip_header(f, config)
-        for chunk in _stream_line_chunks(f):
+        # 8 MB blocks: the transient parsed-float matrix per chunk stays
+        # ~10 MB, keeping two-round peak RSS well under one-round's
+        for chunk in _stream_line_chunks(f, chunk_bytes=8 << 20):
             chunk = b"\n".join(
                 ln for ln in chunk.split(b"\n") if ln.strip()) + b"\n"
             if chunk == b"\n":
@@ -380,7 +413,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
             elif cfeats.shape[1] > ncols:
                 cfeats = cfeats[:, :ncols]
             if sharding:
-                sel = (np.arange(row0, row0 + k) % num_shards) == rank
+                sel = shard_sel(np.arange(row0, row0 + k))
                 clabel, cfeats = clabel[sel], cfeats[sel]
             kk = len(clabel)
             label[out0:out0 + kk] = clabel
@@ -405,18 +438,27 @@ def _load_two_round(filename: str, config: Config, rank: int,
     if w is not None:
         weights = w.astype(np.float32)
         log.info("Loading weights...")
-    q = _load_sidecar(filename + ".query")
+    # reuse round 1's parse when sharding (the sidecar float parse is a
+    # python loop — don't pay it twice for millions of queries)
+    q = qcounts_all if qb_global is not None \
+        else _load_sidecar(filename + ".query")
     if q is not None:
-        query_boundaries = np.concatenate(
-            [[0], np.cumsum(q.astype(np.int64))]).astype(np.int32)
+        if sharding and qb_global is not None:
+            # query-granular shard: LOCAL boundaries from this rank's
+            # query sizes (whole queries stay together by construction)
+            query_boundaries = np.concatenate(
+                [[0], np.cumsum(qcounts_all[qsel_mask])]).astype(np.int32)
+        else:
+            query_boundaries = np.concatenate(
+                [[0], np.cumsum(q.astype(np.int64))]).astype(np.int32)
         log.info("Loading query boundaries...")
     init = _load_sidecar(filename + ".init")
     local_rows = None
     if sharding:
-        if q is not None:
-            log.fatal("two_round loading cannot shard ranking data by "
-                      "query; use use_two_round_loading=false")
-        keep = np.arange(n_total) % num_shards == rank
+        if qb_global is not None:
+            keep = np.repeat(qsel_mask, qcounts_all)
+        else:
+            keep = np.arange(n_total) % num_shards == rank
         local_rows = np.nonzero(keep)[0].astype(np.int64)
         if w is not None:
             weights = weights[keep]
@@ -639,7 +681,8 @@ def load_dataset(filename: str, config: Config,
                  used_feature_map=used_feature_map,
                  real_feature_index=np.asarray(real_index, dtype=np.int32),
                  num_total_features=ncols, feature_names=names,
-                 metadata=metadata, label_idx=label_idx)
+                 metadata=metadata, label_idx=label_idx,
+                 local_rows=local_rows)
     log.info("Finished loading data file, use %d features with %d data"
              % (ds.num_features, ds.num_data))
 

@@ -1,0 +1,38 @@
+"""Scale behavior of streaming (two-round) ingest: memory stays bounded
+by the chunk size + binned matrix, not by the file size (the reference's
+two-round loading + PipelineReader role, dataset_loader.cpp:170-185)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "ingest_bench.py")
+
+
+def _run(mode_args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--mb", "150", *mode_args],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_two_round_rss_bounded_vs_one_round():
+    """Loading a 150 MB file two-round must cost well under half the
+    one-round loader's ADDED memory (one-round materializes raw bytes +
+    the parsed f64 matrix; two-round holds one chunk + the uint8 bins)."""
+    two = _run([])
+    one = _run(["--one-round"])
+    assert two["rows"] == one["rows"] > 500_000
+    added_two = two["max_rss_mb"] - two["import_rss_mb"]
+    added_one = one["max_rss_mb"] - one["import_rss_mb"]
+    # sanity: both measured something real
+    assert added_one > 100, (one, two)
+    assert added_two < 0.5 * added_one, (one, two)
