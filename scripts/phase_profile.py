@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Per-phase timing + MFU accounting for the fused training iteration
+(VERDICT r1 #5).
+
+Measures, on the current default JAX backend at the bench shape
+(N x F, num_leaves=63, max_bin=255 by default):
+
+  - matmul_peak_tflops: empirical best-case f32 MXU throughput on this
+    chip (8k^3 dense matmul) — the utilization denominator, so no
+    hardware spec sheet is assumed.
+  - hist_sweep_ms / hist_tflops / hist_mfu: one full-row Pallas radix
+    histogram sweep; FLOPs counted as the ACTUAL MXU work (including the
+    off-diagonal waste blocks) and as USEFUL FLOPs (diagonal only, 1/4),
+    giving both machine utilization and algorithmic efficiency.
+  - xla_hist_ms: the one-hot matmul oracle (ops/histogram.py) at the
+    same shape — quantifies what the radix kernel buys at F=28 and
+    F=512.
+  - phase split of one boosting iteration: gradients / tree growth
+    (histograms+scan+partition) / score+valid updates + packing, from
+    nested timed jits; plus the fused single-dispatch iteration they
+    compose into.
+
+Prints ONE JSON line.  Run with BENCH_ROWS / PROFILE_FEATS to vary the
+shape; results are recorded in BASELINE.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the environment pins JAX_PLATFORMS to the TPU tunnel at interpreter
+# start; PROFILE_DEVICE=cpu flips the platform the supported way (before
+# backend init), like the CLI's device_type=cpu
+if os.environ.get("PROFILE_DEVICE"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", os.environ["PROFILE_DEVICE"])
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+MAX_BIN = 255
+NUM_LEAVES = 63
+
+
+def _force(out):
+    """Full completion barrier that works through the remote TPU tunnel:
+    block_until_ready alone has been observed returning early there, so
+    read one scalar back to the host."""
+    import jax
+    import jax.numpy as jnp
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def timed(fn, *args, reps=10):
+    """Per-call device time through a HIGH-LATENCY tunnel: the ~200 ms
+    host<->device round trip dwarfs sub-ms kernels, so measure one call
+    (T1 = rtt + t) and a chain of `reps` calls with a single readback
+    (TK = rtt + reps*t; same-stream calls serialize on device) and take
+    the slope (TK - T1) / (reps - 1)."""
+    out = fn(*args)
+    _force(out)
+    t0 = time.time()
+    out = fn(*args)
+    _force(out)
+    t1 = time.time() - t0
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    tk = time.time() - t0
+    return max((tk - t1) / (reps - 1), 1e-9)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import hist_pallas as hp
+    from lightgbm_tpu.ops.histogram import leaf_histogram, make_gvals
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+    res = {"backend": backend, "rows": N_ROWS}
+
+    # empirical matmul peaks: utilization denominators.  f32 dots run the
+    # MXU in multiple passes; bf16 is the single-pass peak, which is the
+    # right ceiling for the histogram kernel's one-hot dots (XLA may run
+    # them at bf16-class rates since one-hots are exactly representable)
+    k = 4096 if backend != "tpu" else 8192
+    a = jnp.asarray(rng.randn(k, k), dtype=jnp.float32)
+    mm = jax.jit(lambda x: x @ x)
+    mm_s = timed(mm, a, reps=5)
+    res["matmul_peak_f32_tflops"] = round(2 * k**3 / mm_s / 1e12, 2)
+    ab = a.astype(jnp.bfloat16)
+    mmb = jax.jit(lambda x: jax.lax.dot(x, x,
+                                        preferred_element_type=jnp.float32))
+    mmb_s = timed(mmb, ab, reps=5)
+    res["matmul_peak_bf16_tflops"] = round(2 * k**3 / mmb_s / 1e12, 2)
+    peak_tflops = max(2 * k**3 / mm_s, 2 * k**3 / mmb_s) / 1e12
+
+    for f in (28, 512):
+        n = N_ROWS if f == 28 else max(N_ROWS // 8, 1 << 17)
+        n = (n // hp.PALLAS_ROW_BLOCK) * hp.PALLAS_ROW_BLOCK
+        bins = jnp.asarray(rng.randint(0, MAX_BIN, size=(f, n)),
+                           dtype=jnp.uint8)
+        grad = jnp.asarray(rng.randn(n), dtype=jnp.float32)
+        hess = jnp.ones(n, dtype=jnp.float32)
+        gh2 = hp.make_gh2(grad, hess)
+        mask = jnp.ones(n, dtype=bool)
+
+        pallas_fn = jax.jit(lambda b, g, m: hp.leaf_histogram_pallas(
+            b, g, m, max_bin=MAX_BIN))
+        p_s = timed(pallas_fn, bins, gh2, mask, reps=200)
+
+        # actual MXU FLOPs: per grid step, ceil(fb/4) block-diagonal
+        # [96, blk] x [blk, 128] matmuls over every row block
+        fb = hp._feat_block(f)
+        n_mm = -(-fb // hp.MM_FEATS) * -(-f // fb)
+        flops = 2 * hp.M_ROWS * hp.N_COLS * n * n_mm
+        useful = flops / (hp.MM_FEATS ** 2) * hp.MM_FEATS  # diagonal 1/4
+        key = "F%d" % f
+        res[key] = {
+            "rows": n,
+            "pallas_sweep_ms": round(p_s * 1e3, 3),
+            "actual_tflops": round(flops / p_s / 1e12, 2),
+            "mxu_utilization": round(flops / p_s / 1e12 / peak_tflops, 3),
+            "useful_tflops": round(useful / p_s / 1e12, 2),
+            "hbm_gb_per_s": round((f * n + 12 * n) / p_s / 1e9, 1),
+        }
+
+        gvals = make_gvals(grad, hess, mask, jnp.float32)
+        xla_fn = jax.jit(lambda b, g: leaf_histogram(b, g, max_bin=MAX_BIN))
+        try:
+            x_s = timed(xla_fn, bins, gvals, reps=20)
+            res[key]["xla_onehot_ms"] = round(x_s * 1e3, 3)
+            res[key]["pallas_speedup_vs_xla"] = round(x_s / p_s, 2)
+        except Exception as e:  # OOM at F=512 x 1M is expected on CPU
+            res[key]["xla_onehot_ms"] = None
+            res[key]["xla_error"] = str(e)[:80]
+
+    # ---- phase split of one boosting iteration at the bench shape ----
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting, _make_fused_step
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.ops.grow import grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    import bench
+
+    x, y = bench.make_data()
+    cfg = Config.from_params(bench._params())
+    ds = bench.build_dataset(cfg, x, y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = create_boosting(cfg, ds, obj)
+    booster.train_one_iter(None, None, False)   # compile + warm state
+    jax.block_until_ready(booster.scores)
+
+    grad_fn = jax.jit(obj.make_grad_fn())
+    t_grad = timed(grad_fn, booster.scores[0], obj.grad_state(), reps=100)
+
+    grow_kw = dict(max_leaves=NUM_LEAVES, max_bin=booster.max_bin,
+                   params=booster.params, max_depth=cfg.max_depth,
+                   hist_impl=booster.hist_impl,
+                   hist_slots=booster.hist_slots)
+    g, h = grad_fn(booster.scores[0], obj.grad_state())
+    bag = jnp.ones(booster.n_pad, dtype=bool)
+    fmask = jnp.ones(ds.num_features, dtype=bool)
+    grow_fn = jax.jit(lambda *a: grow_tree(*a, **grow_kw))
+    t_grow = timed(grow_fn, booster.bins_dev, g.astype(booster.dtype),
+                   h.astype(booster.dtype), bag, fmask, reps=5)
+
+    fused = _make_fused_step(obj.make_grad_fn(), grow_kw,
+                             booster.shrinkage_rate, booster.dtype)
+
+    def fused_once(scores, bag, fmask, bins, gstate):
+        return fused(scores, [], bag, fmask, bins, (), gstate)
+
+    # donated buffers chain naturally (out feeds the next call): time a
+    # 1-call and a reps-call chain, one readback each, take the slope
+    s = jnp.array(booster.scores)
+    out = fused_once(s, bag, fmask, booster.bins_dev, obj.grad_state())
+    _force(out)
+    t0 = time.time()
+    out = fused_once(out[0], bag, fmask, booster.bins_dev,
+                     obj.grad_state())
+    _force(out)
+    t1 = time.time() - t0
+    reps = 10
+    t0 = time.time()
+    for _ in range(reps):
+        out = fused_once(out[0], bag, fmask, booster.bins_dev,
+                         obj.grad_state())
+    _force(out)
+    t_fused = max((time.time() - t0 - t1) / (reps - 1), 1e-9)
+
+    res["phase_ms"] = {
+        "gradients": round(t_grad * 1e3, 2),
+        "grow_tree_hist_scan_partition": round(t_grow * 1e3, 2),
+        "fused_full_iteration": round(t_fused * 1e3, 2),
+        "score_update_pack_overhead": round((t_fused - t_grow - t_grad)
+                                            * 1e3, 2),
+    }
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
