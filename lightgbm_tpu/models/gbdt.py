@@ -243,6 +243,19 @@ class GBDT:
         n_for_pad = self._n_pad_base if self._mh else n
         self.n_pad = ((n_for_pad + row_unit - 1) // row_unit) * row_unit
 
+        # small-leaf row compaction (ops/grow.py hist_small): serial
+        # learner only, f32 only — the f64 parity configuration keeps the
+        # full-sweep accumulation grouping the golden logs pin.
+        # EXPERIMENTAL opt-in: on current TPUs the XLA gather/scatter row
+        # selection costs more per split than the near-peak-MXU full
+        # sweep it avoids (measured 4.5x slower at 1Mx28 — BASELINE.md)
+        self.hist_compact = 0
+        if (config.hist_compact == "on" and self.grower is None
+                and self.dtype == jnp.float32):
+            half = max(self.n_pad // 2, 1)
+            self.hist_compact = ((half + row_unit - 1)
+                                 // row_unit) * row_unit
+
         bins = train_data.bins
         if self.n_pad != n:
             bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
@@ -439,14 +452,15 @@ class GBDT:
         key = (self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
-               self.hist_slots)
+               self.hist_slots, self.hist_compact)
         fn = _FUSED_STEPS.get(key)
         if fn is None:
             grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
                            max_bin=self.max_bin, params=self.params,
                            max_depth=cfg.max_depth,
                            hist_impl=self.hist_impl,
-                           hist_slots=self.hist_slots)
+                           hist_slots=self.hist_slots,
+                           compact=self.hist_compact)
             fn = _make_fused_step(self.objective.make_grad_fn(), grow_kw,
                                   lr, self.dtype)
             _FUSED_STEPS[key] = fn
@@ -493,7 +507,8 @@ class GBDT:
                 bag_mask_dev, jnp.asarray(fmask),
                 max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
                 params=self.params, max_depth=cfg.max_depth,
-                hist_impl=self.hist_impl, hist_slots=self.hist_slots)
+                hist_impl=self.hist_impl, hist_slots=self.hist_slots,
+                compact=self.hist_compact)
 
         lr = self.shrinkage_rate
         # train-score update: leaf_value[leaf_id] gather for ALL rows —

@@ -142,7 +142,7 @@ def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
                      "row_chunk", "psum_axis", "feature_axis",
                      "voting_top_k", "hist_impl", "hist_agg", "num_shards",
-                     "hist_slots"))
+                     "hist_slots", "compact"))
 def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               bag_mask: jax.Array, feature_mask: jax.Array, *,
               max_leaves: int, max_bin: int, params: SplitParams,
@@ -151,7 +151,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               feature_axis: Optional[str] = None,
               voting_top_k: int = 0, hist_impl: str = "xla",
               hist_agg: str = "psum", num_shards: int = 0,
-              hist_slots: int = 0):
+              hist_slots: int = 0, compact: int = 0):
     """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
 
     bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
@@ -277,6 +277,73 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
             return hist_psum(leaf_histogram(bins_t, gv, max_bin=max_bin,
                                             row_chunk=row_chunk))
 
+    # -- compacted small-leaf histograms (serial fast path) ------------
+    # Profiling (BASELINE.md): full-row sweeps are ~90% of the fused
+    # iteration, and every split sweeps all N rows for the SMALLER child
+    # (O(N*num_leaves) row-touches per tree vs the reference's O(N*depth)
+    # leaf-row partitions, data_partition.hpp).  Here the smaller child's
+    # in-bag rows are compacted (order-preserving cumsum scatter, so
+    # accumulation order matches the full sweep's row order) into the
+    # smallest of a static capacity ladder [~N/2, /4, /16, /64] and only
+    # that buffer is swept — near-leaf-proportional MXU work with static
+    # shapes via lax.switch.  The top capacity can never overflow: the
+    # smaller-by-bagged-count child has <= floor(bagged_n/2) <= n/2 rows.
+    # Serial-only (a shard-local count could exceed a local capacity and
+    # branch divergence would break SPMD collective pairing).
+    compact_on = (compact > 0 and psum_axis is None
+                  and feature_axis is None)
+    if compact_on:
+        row_unit = 1
+        if hist_impl == "pallas":
+            from .hist_pallas import PALLAS_ROW_BLOCK
+            row_unit = PALLAS_ROW_BLOCK
+
+        def _round_up(x):
+            return max(1, -(-x // row_unit)) * row_unit
+
+        caps = [_round_up(compact)]
+        while caps[-1] // 4 >= row_unit and len(caps) < 4:
+            caps.append(_round_up(caps[-1] // 4))
+
+        def _compact_idx(mask):
+            pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            slot = jnp.where(mask & (pos < caps[0]), pos, caps[0])
+            buf = jnp.zeros(caps[0] + 1, jnp.int32).at[slot].set(
+                jnp.arange(n, dtype=jnp.int32))
+            return buf[:caps[0]]
+
+        if hist_impl == "pallas":
+            def _hist_rows(idx, cnt, cap):
+                bins_c = jnp.take(bins_t, idx[:cap], axis=1)
+                gh2_c = jnp.take(gh2, idx[:cap], axis=1)
+                leaf_c = jnp.where(jnp.arange(cap) < cnt, 0, -1) \
+                    .astype(jnp.int32)
+                return leaf_histogram_masked(
+                    bins_c, gh2_c, leaf_c, jnp.int32(0),
+                    max_bin=max_bin, interpret=interpret).astype(dtype)
+        else:
+            def _hist_rows(idx, cnt, cap):
+                bins_c = jnp.take(bins_t, idx[:cap], axis=1)
+                gv = make_gvals(jnp.take(grad, idx[:cap]),
+                                jnp.take(hess, idx[:cap]),
+                                jnp.arange(cap) < cnt, dtype)
+                return leaf_histogram(bins_c, gv, max_bin=max_bin,
+                                      row_chunk=row_chunk)
+
+        def hist_small(leaf_id, target, cnt):
+            mask = (leaf_id == target) & bag_mask
+            idx = _compact_idx(mask)
+            # smallest capacity that fits cnt (capacities descend)
+            sel = jnp.int32(0)
+            for b, cap in enumerate(caps[1:], start=1):
+                sel = jnp.where(cnt <= cap, jnp.int32(b), sel)
+            branches = [functools.partial(_hist_rows, cap=cap)
+                        for cap in caps]
+            return jax.lax.switch(sel, branches, idx, cnt)
+    else:
+        def hist_small(leaf_id, target, cnt):
+            return hist_leaf(leaf_id, target)
+
     def depth_gated(gain, depth):
         if max_depth > 0:
             return jnp.where(depth >= max_depth, K_MIN_SCORE, gain)
@@ -397,7 +464,8 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         # --- histograms: smaller child scanned, larger by subtraction ---
         left_is_smaller = si[BI_LCNT] <= si[BI_RCNT]
         small_leaf = jnp.where(left_is_smaller, bl, right)
-        small_hist = hist_leaf(leaf_id, small_leaf)
+        small_cnt = jnp.where(left_is_smaller, si[BI_LCNT], si[BI_RCNT])
+        small_hist = hist_small(leaf_id, small_leaf, small_cnt)
         if pooled:
             # parent histogram from its pool slot, or a full recompute
             # when it was LRU-evicted (the reference recomputes evicted

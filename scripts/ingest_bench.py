@@ -33,6 +33,13 @@ sys.path.insert(0, REPO)
 CACHE = os.path.join(REPO, ".bench_cache")
 N_FEAT = 28
 
+# ingest is host-only; keep the remote TPU tunnel (and its RSS/latency
+# noise) out of the measurement — sitecustomize pins JAX_PLATFORMS, so
+# flip via jax.config before any backend init
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def ensure_file(target_bytes: int) -> str:
     os.makedirs(CACHE, exist_ok=True)
