@@ -737,6 +737,11 @@ class GBDT:
     # so output formatting stays byte-identical to the reference under
     # any backend configuration.
     PREDICT_CHUNK = 1 << 17
+    # matmul predictor: trees per scan block and rows per chunk (the
+    # [C, tb*M, 4] selection temporary bounds memory)
+    PREDICT_TREE_BLOCK = 8
+    PREDICT_MM_CHUNK = 1 << 16
+    PREDICT_INFLIGHT = 8
 
     def _stacked_trees(self, nmodels: int):
         """Padded [T, M]/[T, L] arrays for the first nmodels trees,
@@ -768,14 +773,95 @@ class GBDT:
             lv[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
         th, tl = split_hi_lo(thr)
         dev = tuple(jnp.asarray(a) for a in (sf, th, tl, lc, rc))
-        pack = (dev, lv)
+        # the matmul-predictor pack builds LAZILY (first accelerator
+        # predict): CPU-only runs never pay its DFS/uploads
+        pack = {"dev": dev, "lv": lv, "mm": None, "mm_built": False,
+                "np": (trees, sf, th, tl, lc, rc, max_l, m)}
         self._stack_cache = (key, pack)
         return pack
 
+    def _matmul_cached(self, pack):
+        if not pack["mm_built"]:
+            pack["mm"] = self._matmul_pack(*pack["np"])
+            pack["mm_built"] = True
+        return pack["mm"]
+
+    def _matmul_pack(self, trees, sf, th, tl, lc, rc, max_l, m):
+        """Arrays for the gather-free matmul predictor
+        (ops/predict.predict_leaf_matmul): one-hot feature selection,
+        per-feature threshold rank tables (for host rank_encode) + node
+        rank codes, and per-tree path matrices."""
+        t_cnt = len(trees)
+        # pad the tree count to the scan's block multiple; dummy trees
+        # have an all-zero path and depth[0] = 0, so they argmax to leaf
+        # 0 and are sliced off by the caller
+        t_pad = -(-t_cnt // self.PREDICT_TREE_BLOCK) \
+            * self.PREDICT_TREE_BLOCK
+        ftot = self.max_feature_idx + 1
+        if ftot * t_pad * m > (1 << 26):
+            # wide-feature models would make the one-hot selection
+            # matrix hundreds of MB (e.g. 200k sparse features); the
+            # descent path handles those instead
+            return None
+        sel = np.zeros((ftot, t_pad * m), dtype=np.float32)
+        real = np.zeros((t_cnt, m), dtype=bool)
+        for i in range(t_cnt):
+            ni = trees[i].num_leaves - 1
+            real[i, :ni] = True
+            for j in range(ni):
+                sel[sf[i, j], i * m + j] = 1.0
+        key = ((th.astype(np.uint64) << np.uint64(32))
+               | tl.astype(np.uint64))            # [T, M] order keys
+        tables = []
+        for f in range(ftot):
+            sel_f = real & (sf == f)
+            tables.append(np.unique(key[sel_f]))
+        if max(len(t) for t in tables) >= 65535:
+            return None   # uint16 codes overflow; descent path instead
+        thr_code = np.zeros(t_pad * m, dtype=np.float32)
+        for i in range(t_cnt):
+            for j in range(trees[i].num_leaves - 1):
+                thr_code[i * m + j] = np.searchsorted(
+                    tables[sf[i, j]], key[i, j], side="left")
+        pos = np.zeros((t_pad, m, max_l), dtype=np.float32)
+        neg = np.zeros((t_pad, m, max_l), dtype=np.float32)
+        depth = np.full((t_pad, max_l), np.inf, dtype=np.float32)
+        depth[t_cnt:, 0] = 0.0
+        for i, t in enumerate(trees):
+            # DFS from the root: child >= 0 is an internal node, ~child
+            # is a leaf (tree.py wire format)
+            stack = [(0, [])] if t.num_leaves > 1 else []
+            if t.num_leaves == 1:
+                depth[i, 0] = 0.0
+            while stack:
+                node, path = stack.pop()
+                for child, sign in ((lc[i, node], 1.0),
+                                    (rc[i, node], -1.0)):
+                    cpath = path + [(node, sign)]
+                    if child < 0:
+                        leaf = ~child
+                        depth[i, leaf] = len(cpath)
+                        for nd, sg in cpath:
+                            (pos if sg > 0 else neg)[i, nd, leaf] = 1.0
+                    else:
+                        stack.append((int(child), cpath))
+        return (tables, (jnp.asarray(sel), jnp.asarray(thr_code),
+                         jnp.asarray(pos), jnp.asarray(neg),
+                         jnp.asarray(depth)))
+
     def _predict_leaves(self, x: np.ndarray, nmodels: int) -> np.ndarray:
-        """[N, F] raw values -> [N, T] i32 leaf indices via the device
-        traversal, chunked so memory stays bounded."""
-        from ..ops.predict import predict_leaf_stacked, split_hi_lo
+        """[N, F] raw values -> [N, T] i32 leaf indices on device,
+        chunked so memory stays bounded.
+
+        Two kernels, same exact f64 routing semantics: accelerators take
+        the gather-free matmul predictor (pointer-chasing descents cost
+        one serialized gather per level per tree on TPU — measured 9x
+        SLOWER than host numpy at 1Mx20; the matmul form runs on the
+        MXU); CPU keeps the while-loop descent (XLA CPU handles the
+        gathers fine and skips the O(C*M) compare work)."""
+        from ..ops.predict import (predict_leaf_matmul,
+                                   predict_leaf_stacked, rank_encode,
+                                   split_hi_lo)
         x = np.asarray(x, dtype=np.float64)
         want = self.max_feature_idx + 1
         if x.shape[1] < want:
@@ -783,11 +869,30 @@ class GBDT:
             # missing-value convention (predictor.hpp feature buffer) —
             # a narrow matrix must not silently gather-clamp on device
             x = np.pad(x, ((0, 0), (0, want - x.shape[1])))
-        dev, _ = self._stacked_trees(nmodels)
+        elif x.shape[1] > want:
+            x = x[:, :want]
+        pack = self._stacked_trees(nmodels)
+        dev = pack["dev"]
+        mm = (self._matmul_cached(pack)
+              if jax.default_backend() != "cpu" else None)
+        use_mm = mm is not None
+        step = self.PREDICT_MM_CHUNK if use_mm else self.PREDICT_CHUNK
         n = x.shape[0]
         out = np.empty((n, nmodels), dtype=np.int64)
-        for a in range(0, n, self.PREDICT_CHUNK):
-            chunk = np.ascontiguousarray(x[a:a + self.PREDICT_CHUNK])
+        # dispatch chunks asynchronously with a BOUNDED in-flight window:
+        # the device pipelines chunk k+1 while chunk k's result reads
+        # back (the remote-tunnel round trip amortizes), but device
+        # buffers stay O(window), not O(N)
+        pending = []
+
+        def drain(limit):
+            while len(pending) > limit:
+                a, rows, leaves = pending.pop(0)
+                got = np.asarray(leaves)[:rows]
+                out[a:a + rows] = got[:, :nmodels] if use_mm else got
+
+        for a in range(0, n, step):
+            chunk = np.ascontiguousarray(x[a:a + step])
             # pad rows up to a power-of-two bucket: one compiled traversal
             # per bucket instead of one per distinct batch size
             rows = chunk.shape[0]
@@ -797,10 +902,18 @@ class GBDT:
             if bucket > rows:
                 chunk = np.pad(chunk, ((0, bucket - rows), (0, 0)))
             xh, xl = split_hi_lo(chunk)
-            leaves = np.asarray(
-                predict_leaf_stacked(*dev, jnp.asarray(xh),
-                                     jnp.asarray(xl)))
-            out[a:a + self.PREDICT_CHUNK] = leaves[:rows]
+            if use_mm:
+                tables, mm_dev = mm
+                code = rank_encode(xh, xl, tables)
+                leaves = predict_leaf_matmul(
+                    *mm_dev, jnp.asarray(code),
+                    tree_block=self.PREDICT_TREE_BLOCK)
+            else:
+                leaves = predict_leaf_stacked(*dev, jnp.asarray(xh),
+                                              jnp.asarray(xl))
+            pending.append((a, rows, leaves))
+            drain(self.PREDICT_INFLIGHT)
+        drain(0)
         return out
 
     def predict_raw(self, x: np.ndarray) -> np.ndarray:
@@ -811,7 +924,7 @@ class GBDT:
         if nmodels == 0 or n == 0:
             return np.zeros((k, n), dtype=np.float64)
         leaves = self._predict_leaves(x, nmodels)
-        _, lv = self._stacked_trees(nmodels)
+        lv = self._stacked_trees(nmodels)["lv"]
         out = np.zeros((k, n), dtype=np.float64)
         # per-tree f64 accumulation in boosting order, exactly the
         # reference predictor's += tree->Predict (predictor.hpp:35-70)

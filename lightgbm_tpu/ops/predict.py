@@ -120,6 +120,102 @@ def _leaf_hi_lo_inner(split_feature_real, thr_hi, thr_lo, left_child,
     return ~jax.lax.while_loop(cond, body, node)
 
 
+def order_key(hi: "np.ndarray", lo: "np.ndarray") -> "np.ndarray":
+    """(hi, lo) uint32 pair -> uint64 order key.  The ONE definition both
+    the model pack (threshold ranks) and rank_encode (value codes) use —
+    the matmul predictor's exactness rests on the two sides agreeing."""
+    import numpy as np
+    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
+
+def rank_encode(hi: "np.ndarray", lo: "np.ndarray",
+                tables: "list") -> "np.ndarray":
+    """Host-side exact rank encoding of raw values against the MODEL's
+    per-feature threshold tables (prediction-time binning).
+
+    tables[f] is the sorted array of uint64 order keys (split_hi_lo) of
+    every threshold the model compares feature f against.  code(x) =
+    searchsorted(table, key(x)) satisfies  x <= thr[i]  <=>  code(x) <=
+    rank(thr[i])  EXACTLY in the f64 total order — and the codes are
+    tiny integers, so the device upload is uint16 instead of raw keys
+    (16x fewer bytes, the remote-tunnel predict bottleneck) and the
+    selection matmul needs a single exactly-representable plane."""
+    import numpy as np
+    key = order_key(hi, lo)
+    out = np.zeros(hi.shape, dtype=np.uint16)
+    for f, table in enumerate(tables):
+        if len(table):
+            out[:, f] = np.searchsorted(table, key[:, f],
+                                        side="left").astype(np.uint16)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("tree_block",))
+def predict_leaf_matmul(sel: jax.Array, thr_code: jax.Array,
+                        path_pos: jax.Array, path_neg: jax.Array,
+                        leaf_depth: jax.Array, x_code: jax.Array,
+                        *, tree_block: int) -> jax.Array:
+    """Gather-free whole-model leaf indices — the TPU-native predictor.
+
+    Pointer-chasing descents (tree.h:179-189) need one random gather per
+    level per tree, which serializes on TPU.  Instead the traversal is
+    re-expressed as matmuls + an argmax:
+
+      1. node comparisons: the host rank-encodes each value against its
+         feature's model-threshold table (rank_encode — exact f64
+         order), a one-hot selection matmul routes the codes to nodes,
+         and `code <= node_rank` reproduces `value <= threshold`:
+         cmp [C, T*M].
+      2. leaf resolution: a leaf is reached iff every node on its path
+         branched toward it.  With path matrices P± [T, M, L] (+1 node
+         sends the leaf left, -1 right), score = cmp @ P+ + (1-cmp) @ P-
+         counts satisfied path conditions; score - depth is 0 exactly
+         for the reached leaf and <= -1 otherwise, so an argmax over L
+         recovers the leaf with no data-dependent memory access.
+
+    Trees process in blocks of `tree_block` via lax.scan to bound the
+    [C, tb*M] temporaries.  sel [Ftot, T*M] f32; thr_code [T*M] f32;
+    path_pos/neg [T, M, L]; leaf_depth [T, L] (+inf padding slots);
+    x_code [C, Ftot] uint16.  Returns [C, T] i32.
+    """
+    c, ftot = x_code.shape
+    t_total = path_pos.shape[0]
+    m = path_pos.shape[1]
+    nb = t_total // tree_block
+
+    sel_b = sel.reshape(ftot, nb, tree_block * m).transpose(1, 0, 2)
+    thr_b = thr_code.reshape(nb, tree_block * m)
+    pos_b = path_pos.reshape(nb, tree_block, m, -1)
+    neg_b = path_neg.reshape(nb, tree_block, m, -1)
+    dep_b = leaf_depth.reshape(nb, tree_block, 1, -1)
+    xf = x_code.astype(jnp.float32)              # [C, Ftot], ints < 2^16
+
+    def block(_, args):
+        s, th, pp, pn, dp = args
+        # HIGHEST precision: codes are integers up to 65535 and the
+        # TPU's default bf16 matmul (8 mantissa bits) would corrupt
+        # them; the 3-pass f32 mode is exact for one-hot selections
+        xsel = jnp.einsum("cf,fm->cm", xf, s,
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+        cmp = (xsel <= th[None]).astype(jnp.float32)         # [C, tb*m]
+        cmp = cmp.reshape(c, tree_block, m).transpose(1, 0, 2)
+        score = (jnp.einsum("tcm,tml->tcl", cmp, pp,
+                            preferred_element_type=jnp.float32)
+                 + jnp.einsum("tcm,tml->tcl", 1.0 - cmp, pn,
+                              preferred_element_type=jnp.float32))
+        leaf = jnp.argmax(score - dp, axis=-1)               # [tb, C]
+        # uint8 when it fits: the [C, T] result is the bulk of the
+        # device->host traffic (the predict bottleneck over a remote
+        # tunnel) and leaves index at most max_leaves <= 256 slots
+        out_dt = jnp.uint8 if path_pos.shape[2] <= 256 else jnp.int32
+        return None, leaf.astype(out_dt)
+
+    _, leaves = jax.lax.scan(block, None, (sel_b, thr_b, pos_b, neg_b,
+                                           dep_b))
+    return leaves.reshape(t_total, c).T
+
+
 @jax.jit
 def predict_leaf_stacked(split_feature_real: jax.Array, thr_hi: jax.Array,
                          thr_lo: jax.Array, left_child: jax.Array,
