@@ -266,23 +266,21 @@ class Application:
                     for i in range(res.shape[1])]
 
         gen = blocks()
-        wrote = False
+        # pull the first block BEFORE opening (truncating) the output file
+        # so an empty input fatals without clobbering a previous result
+        first = next(gen, None)
+        if first is None:
+            log.fatal("Data file %s is empty" % cfg.data)
         with open(cfg.output_result, "w") as out_f, \
                 ThreadPoolExecutor(max_workers=1) as ex:
-            pending = None
+            pending = ex.submit(parse, first)
             for lines in gen:
                 nxt = ex.submit(parse, lines)
-                if pending is not None:
-                    for row in format_rows(pending.result()):
-                        out_f.write(row + "\n")
-                    wrote = True
-                pending = nxt
-            if pending is not None:
                 for row in format_rows(pending.result()):
                     out_f.write(row + "\n")
-                wrote = True
-        if not wrote:
-            log.fatal("Data file %s is empty" % cfg.data)
+                pending = nxt
+            for row in format_rows(pending.result()):
+                out_f.write(row + "\n")
         log.info("Finished prediction, results saved to %s"
                  % cfg.output_result)
 

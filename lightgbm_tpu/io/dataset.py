@@ -27,6 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..utils import log
+from ..utils.mt19937 import Mt19937Random
 from ..config import Config
 from .binning import BinMapper, find_bin
 from .parser import detect_format, parse_file_bytes
@@ -209,6 +210,22 @@ def _select_used_features(mappers_all, names):
     return used_feature_map, bin_mappers, real_index
 
 
+def _chunk_line_spans(chunk: bytes):
+    """(starts, lens) int64 arrays of the non-empty lines of a
+    \\n-normalized chunk — native scan when available, numpy otherwise."""
+    from .. import native
+    sp = native.line_spans(chunk)
+    if sp is not None:
+        return sp
+    arr = np.frombuffer(chunk, dtype=np.uint8)
+    nl = np.flatnonzero(arr == 10).astype(np.int64)
+    starts = np.concatenate([np.zeros(1, np.int64), nl + 1])
+    ends = np.concatenate([nl, np.asarray([len(chunk)], np.int64)])
+    lens = ends - starts
+    m = lens > 0
+    return starts[m], lens[m]
+
+
 def _scan_libsvm_max_idx(chunk: bytes) -> int:
     """Max feature index in a libsvm chunk (native scan when available)."""
     from .. import native
@@ -243,7 +260,6 @@ def _load_two_round(filename: str, config: Config, rank: int,
     (the query ids would have to be parsed during round 1's raw-line
     scan)."""
     sample_target = max(1, config.bin_construct_sample_cnt)
-    rng = np.random.RandomState(config.data_random_seed)
     sharding = num_shards > 1 and not config.is_pre_partition
 
     # query-granular sharding from the .query sidecar: global row ->
@@ -263,10 +279,19 @@ def _load_two_round(filename: str, config: Config, rank: int,
         return (gidx % num_shards) == rank
 
     # ---- round 1: count rows, reservoir-sample lines ----
-    # block reservoir: assign each line a random key, keep the S smallest
-    # (equivalent to a uniform S-of-N sample, vectorized per chunk)
-    keys = None
+    # The reference's streaming reservoir, replayed bit-exactly
+    # (TextReader::SampleFromFile, text_reader.h:151-168, via
+    # DatasetLoader::SampleTextDataFromFile, dataset_loader.cpp:527-536):
+    # the first S lines fill the reservoir; line i >= S draws
+    # idx = NextInt(0, i+1) on the seeded mt19937 and replaces slot idx
+    # when idx < S — so two-round bin boundaries (and therefore models)
+    # match the reference byte-for-byte.  When sharding, local rows are
+    # selected modulo first (documented divergence from the reference's
+    # RNG-based row partition, PARITY.md) and the replica stream draws
+    # only for local rows.
+    res_rng = Mt19937Random(config.data_random_seed)
     kept: List[bytes] = []
+    n_sampled_seen = 0   # lines eligible for sampling (local rows)
     n_total = 0
     fmt = None
     libsvm_max_idx = -1
@@ -274,13 +299,16 @@ def _load_two_round(filename: str, config: Config, rank: int,
     with open(filename, "rb") as f:
         names = _skip_header(f, config)
         for chunk in _stream_line_chunks(f):
-            lines = [ln for ln in chunk.split(b"\n") if ln.strip()]
-            if not lines:
+            starts, lens = _chunk_line_spans(chunk)
+            k = len(starts)
+            if k == 0:
                 continue
             if fmt is None:
-                first_line = lines[0]
+                l2 = [bytes(chunk[int(starts[t]):int(starts[t] + lens[t])])
+                      for t in range(min(2, k))]
+                first_line = l2[0]
                 fmt = detect_format([ln.decode("utf-8", "replace")
-                                     for ln in lines[:2]])
+                                     for ln in l2])
             if fmt == "libsvm":
                 # schema width must come from the WHOLE file, not the
                 # sample — a feature the sample misses must still occupy
@@ -290,25 +318,28 @@ def _load_two_round(filename: str, config: Config, rank: int,
             if sharding:
                 # sample only THIS rank's rows, like one-round loading
                 # (shard first, then draw the bin sample from local rows)
-                gidx = np.arange(n_total, n_total + len(lines))
-                n_total += len(lines)
+                gidx = np.arange(n_total, n_total + k)
+                n_total += k
                 sel = shard_sel(gidx)
-                lines = [ln for ln, s in zip(lines, sel) if s]
-                if not lines:
+                starts, lens = starts[sel], lens[sel]
+                k = len(starts)
+                if k == 0:
                     continue
             else:
-                n_total += len(lines)
-            ck = rng.rand(len(lines))
-            if keys is None:
-                keys = ck
-                kept = lines
-            else:
-                keys = np.concatenate([keys, ck])
-                kept = kept + lines
-            if len(kept) > sample_target:
-                top = np.argpartition(keys, sample_target)[:sample_target]
-                keys = keys[top]
-                kept = [kept[i] for i in top]
+                n_total += k
+            i0 = n_sampled_seen
+            n_sampled_seen += k
+            fill = max(0, min(sample_target - i0, k))
+            for t in range(fill):
+                a = int(starts[t])
+                kept.append(bytes(chunk[a:a + int(lens[t])]))
+            if k > fill:
+                ubs = np.arange(i0 + fill + 1, i0 + k + 1, dtype=np.int64)
+                idxs = res_rng.next_ints(ubs)
+                for t in np.flatnonzero(idxs < sample_target):
+                    a = int(starts[fill + t])
+                    kept[int(idxs[t])] = bytes(
+                        chunk[a:a + int(lens[fill + t])])
     if n_total == 0:
         log.fatal("Data file %s is empty" % filename)
 
@@ -374,7 +405,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
     # round-1 artifacts (reservoir lines + parsed sample floats) are tens
     # of MB at default sample counts — free them so round 2's peak RSS is
     # one chunk + the uint8 bins, the whole point of two-round loading
-    del kept, keys, sample_raw, sample_feats
+    del kept, sample_raw, sample_feats
 
     # ---- round 2: parse + quantize chunk by chunk ----
     if not sharding:
@@ -394,13 +425,68 @@ def _load_two_round(filename: str, config: Config, rank: int,
     label = np.empty(n_local, dtype=np.float32)
     weights = np.empty(n_local, dtype=np.float32) if weight_idx >= 0 else None
     qid = np.empty(n_local, dtype=np.int64) if group_idx >= 0 else None
+    # Fused multithreaded parse+quantize (the reference parses with
+    # OpenMP across row blocks, dataset_loader.cpp:715-790 +
+    # text_reader.h:214-290; here each chunk fans out over threads in
+    # ONE native call that bins straight into the [F, N] matrix, so the
+    # per-chunk float matrix of the fallback path never exists).
+    from .. import native
+    spec = native.BinSpec(bin_mappers) if native.get_lib() else None
+    fused = None
+    if spec is not None and spec.ok and dtype == np.uint8:
+        if fmt in ("tsv", "csv"):
+            nfile = ncols + 1
+            col_map = np.empty(nfile, dtype=np.int32)
+            for c in range(nfile):
+                if c == label_idx:
+                    col_map[c] = -2
+                    continue
+                j = c - 1 if c > label_idx else c
+                if j == weight_idx:
+                    col_map[c] = -3
+                elif j == group_idx:
+                    col_map[c] = -4
+                else:
+                    col_map[c] = used_feature_map[j] if j < ncols else -1
+            fused = "dense"
+        elif weight_idx < 0 and group_idx < 0:
+            feat_map = used_feature_map.astype(np.int32)
+            if len(feat_map) < ncols:
+                feat_map = np.concatenate(
+                    [feat_map, np.full(ncols - len(feat_map), -1,
+                                       np.int32)])
+            zero_bin = np.asarray(
+                [m.value_to_bin(np.zeros(1))[0] for m in bin_mappers],
+                dtype=np.uint8)
+            fused = "libsvm"
+
     row0 = 0   # global row counter
     out0 = 0   # local write position
     with open(filename, "rb") as f:
         _skip_header(f, config)
-        # 8 MB blocks: the transient parsed-float matrix per chunk stays
-        # ~10 MB, keeping two-round peak RSS well under one-round's
+        # 8 MB blocks: the transient parse state per chunk stays small,
+        # keeping two-round peak RSS well under one-round's
         for chunk in _stream_line_chunks(f, chunk_bytes=8 << 20):
+            if fused is not None:
+                keep = None
+                if sharding:
+                    k = native.count_lines(chunk)
+                    keep = shard_sel(np.arange(row0, row0 + k))
+                if fused == "dense":
+                    kk, k = native.parse_bin_dense_chunk(
+                        chunk, "\t" if fmt == "tsv" else ",", nfile,
+                        col_map, spec, keep, bins[:, out0:], n_local,
+                        n_local - out0, label[out0:],
+                        weights[out0:] if weights is not None else None,
+                        qid[out0:] if qid is not None else None)
+                else:
+                    kk, k = native.parse_bin_libsvm_chunk(
+                        chunk, ncols - 1, feat_map, spec, zero_bin, keep,
+                        bins[:, out0:], n_local, n_local - out0,
+                        label[out0:])
+                row0 += k
+                out0 += kk
+                continue
             chunk = b"\n".join(
                 ln for ln in chunk.split(b"\n") if ln.strip()) + b"\n"
             if chunk == b"\n":
@@ -638,8 +724,12 @@ def load_dataset(filename: str, config: Config,
     # ---- find bins on a sample (bin_construct_sample_cnt rows) ----
     sample_cnt = min(config.bin_construct_sample_cnt, n)
     if sample_cnt < n:
-        rng = np.random.RandomState(config.data_random_seed)
-        sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+        # Random::Sample on the seeded mt19937 replica — the reference's
+        # one-round sample (DatasetLoader::SampleTextDataFromMemory,
+        # dataset_loader.cpp:514-526), so sub-sampled bin boundaries
+        # match the reference bit-for-bit
+        sample_idx = Mt19937Random(config.data_random_seed).sample(
+            n, sample_cnt)
         sample = feats[sample_idx]
     else:
         sample = feats

@@ -34,7 +34,8 @@ def _src_digest() -> str:
 
 
 def _build(digest: str) -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -111,8 +112,38 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_ndcg_eval.restype = None
     lib.lgt_parse_doubles.argtypes = [ctypes.c_char_p, i64, pd, i64]
     lib.lgt_parse_doubles.restype = i64
+    i32 = ctypes.c_int32
+    lib.lgt_count_lines.argtypes = [ctypes.c_char_p, i64, i32]
+    lib.lgt_count_lines.restype = i64
+    lib.lgt_line_spans.argtypes = [ctypes.c_char_p, i64, pi64, pi64, i64]
+    lib.lgt_line_spans.restype = i64
+    lib.lgt_parse_bin_dense_mt.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_char, i64, pi32, pd, pi64, pi32,
+        pu8, i64, pu8, i64, i64, pf, pf, pi64, i32, pi64]
+    lib.lgt_parse_bin_dense_mt.restype = i64
+    lib.lgt_parse_bin_libsvm_mt.argtypes = [
+        ctypes.c_char_p, i64, i64, pi32, pd, pi64, pi32, pu8, i64, pu8,
+        i64, pu8, i64, i64, pf, i32, pi64]
+    lib.lgt_parse_bin_libsvm_mt.restype = i64
+    lib.lgt_parse_dense_mt.argtypes = [ctypes.c_char_p, i64, ctypes.c_char,
+                                       pd, i64, i64, i32]
+    lib.lgt_parse_dense_mt.restype = i64
+    lib.lgt_selection_mask.argtypes = [pd, i64, i64, pu8]
+    lib.lgt_selection_mask.restype = None
     _lib = lib
     return _lib
+
+
+def default_threads() -> int:
+    """Parse/bin thread count: LGBM_TPU_NUM_THREADS, else all cores (the
+    reference's OpenMP default)."""
+    v = os.environ.get("LGBM_TPU_NUM_THREADS")
+    if v:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
 
 
 def _dbl_ptr(a: np.ndarray):
@@ -121,8 +152,9 @@ def _dbl_ptr(a: np.ndarray):
 
 def parse_dense(text: bytes, sep: str) -> Optional[np.ndarray]:
     """text -> [rows, cols] f64, or None when native is unavailable.
-    Raises on malformed tokens (reference Atof Log::Fatal,
-    common.h:283-286)."""
+    Thread-parallel across row blocks (the reference parses with OpenMP
+    the same way, dataset_loader.cpp:715-790).  Raises on malformed
+    tokens (reference Atof Log::Fatal, common.h:283-286)."""
     lib = get_lib()
     if lib is None:
         return None
@@ -133,12 +165,136 @@ def parse_dense(text: bytes, sep: str) -> Optional[np.ndarray]:
     if rows.value == 0:
         return np.zeros((0, 0), dtype=np.float64)
     out = np.empty((rows.value, cols.value), dtype=np.float64)
-    got = lib.lgt_parse_dense(text, len(text), sep.encode()[0],
-                              _dbl_ptr(out), rows.value, cols.value)
+    got = lib.lgt_parse_dense_mt(text, len(text), sep.encode()[0],
+                                 _dbl_ptr(out), rows.value, cols.value,
+                                 default_threads())
     if got < 0:
         from ..utils import log
         log.fatal("Unknown token in data file at row %d" % (-got - 1))
     return out[:got]
+
+
+def count_lines(text: bytes) -> Optional[int]:
+    """Non-empty line count, thread-parallel; None without native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return lib.lgt_count_lines(text, len(text), default_threads())
+
+
+def line_spans(text: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(starts, lens) int64 arrays of the non-empty lines, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = lib.lgt_count_lines(text, len(text), default_threads())
+    starts = np.empty(cap, dtype=np.int64)
+    lens = np.empty(cap, dtype=np.int64)
+    pi = ctypes.POINTER(ctypes.c_int64)
+    n = lib.lgt_line_spans(text, len(text), starts.ctypes.data_as(pi),
+                           lens.ctypes.data_as(pi), cap)
+    return starts[:n], lens[:n]
+
+
+def _i32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8_ptr(a):
+    return (a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            if a is not None else None)
+
+
+class BinSpec:
+    """Flattened per-feature bin bounds for the fused parse+bin kernels
+    (built once per load from the BinMapper list)."""
+
+    def __init__(self, bin_mappers):
+        bounds = [np.asarray(m.bin_upper_bound, dtype=np.float64)
+                  for m in bin_mappers]
+        self.offs = np.zeros(len(bounds) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bounds], out=self.offs[1:])
+        self.flat = (np.concatenate(bounds) if bounds
+                     else np.zeros(0, dtype=np.float64))
+        self.num_bins = np.asarray([len(b) for b in bounds],
+                                   dtype=np.int32)
+        self.ok = bool(len(bounds) == 0
+                       or (self.num_bins <= 256).all())
+
+
+_OVERFLOW = -(1 << 63)
+
+
+def _check_parse_rc(got: int) -> None:
+    from ..utils import log
+    if got == _OVERFLOW:
+        log.fatal("Data file changed between loading passes "
+                  "(more rows than round 1 counted)")
+    if got < 0:
+        log.fatal("Unknown token in data file at row %d" % (-got - 1))
+
+
+def parse_bin_dense_chunk(text: bytes, sep: str, ncols: int,
+                          col_map: np.ndarray, spec: "BinSpec",
+                          keep: Optional[np.ndarray], bins_view: np.ndarray,
+                          stride: int, out_cap: int, label_out: np.ndarray,
+                          weight_out: Optional[np.ndarray],
+                          qid_out: Optional[np.ndarray]):
+    """Fused parse+quantize of one dense chunk straight into the
+    feature-major bin matrix (col_map semantics in ingest.cpp).
+    bins_view must be the [F, stride] array offset so row 0 is this
+    chunk's first output slot; out_cap bounds the rows written (stale
+    round-1 row counts fatal instead of writing out of bounds).
+    Returns (rows_written, rows_seen) or None when native is
+    unavailable / bins are not uint8."""
+    lib = get_lib()
+    if lib is None or not spec.ok or bins_view.dtype != np.uint8:
+        return None
+    seen = ctypes.c_int64()
+    col_map = np.ascontiguousarray(col_map, dtype=np.int32)
+    keep_arr = (np.ascontiguousarray(keep, dtype=np.uint8)
+                if keep is not None else None)
+    got = lib.lgt_parse_bin_dense_mt(
+        text, len(text), sep.encode()[0], ncols, _i32_ptr(col_map),
+        _dbl_ptr(spec.flat),
+        spec.offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _i32_ptr(spec.num_bins), _u8_ptr(keep_arr),
+        0 if keep_arr is None else len(keep_arr), _u8_ptr(bins_view),
+        stride, out_cap,
+        label_out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        (weight_out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+         if weight_out is not None else None),
+        (qid_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+         if qid_out is not None else None),
+        default_threads(), ctypes.byref(seen))
+    _check_parse_rc(got)
+    return got, seen.value
+
+
+def parse_bin_libsvm_chunk(text: bytes, max_idx: int, feat_map: np.ndarray,
+                           spec: "BinSpec", zero_bin: np.ndarray,
+                           keep: Optional[np.ndarray],
+                           bins_view: np.ndarray, stride: int,
+                           out_cap: int, label_out: np.ndarray):
+    """Fused parse+quantize of one libsvm chunk (see ingest.cpp)."""
+    lib = get_lib()
+    if lib is None or not spec.ok or bins_view.dtype != np.uint8:
+        return None
+    seen = ctypes.c_int64()
+    feat_map = np.ascontiguousarray(feat_map, dtype=np.int32)
+    zero_bin = np.ascontiguousarray(zero_bin, dtype=np.uint8)
+    keep_arr = (np.ascontiguousarray(keep, dtype=np.uint8)
+                if keep is not None else None)
+    got = lib.lgt_parse_bin_libsvm_mt(
+        text, len(text), max_idx, _i32_ptr(feat_map), _dbl_ptr(spec.flat),
+        spec.offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _i32_ptr(spec.num_bins), _u8_ptr(zero_bin), len(zero_bin),
+        _u8_ptr(keep_arr), 0 if keep_arr is None else len(keep_arr),
+        _u8_ptr(bins_view), stride, out_cap,
+        label_out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        default_threads(), ctypes.byref(seen))
+    _check_parse_rc(got)
+    return got, seen.value
 
 
 def parse_doubles(text: bytes, n: int) -> Optional[np.ndarray]:
@@ -250,6 +406,20 @@ def scan_libsvm(text: bytes) -> Optional[Tuple[int, int]]:
     lib.lgt_scan_libsvm(text, len(text), ctypes.byref(rows),
                         ctypes.byref(max_idx))
     return rows.value, max_idx.value
+
+
+def selection_mask(draws: np.ndarray, k: int) -> Optional[np.ndarray]:
+    """Selection-sampling acceptance mask over a NextDouble stream
+    (reference random.h:55-67), or None without native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    draws = np.ascontiguousarray(draws, dtype=np.float64)
+    mask = np.empty(len(draws), dtype=np.uint8)
+    lib.lgt_selection_mask(_dbl_ptr(draws), len(draws), int(k),
+                           mask.ctypes.data_as(
+                               ctypes.POINTER(ctypes.c_uint8)))
+    return mask.astype(bool)
 
 
 def sort_importance(counts: np.ndarray) -> Optional[np.ndarray]:

@@ -291,6 +291,11 @@ class LambdarankNDCG(Objective):
         if metadata.query_boundaries is None:
             log.fatal("Lambdarank tasks require query information")
         self.qb = metadata.query_boundaries
+        # reference src/io/metadata.cpp CheckOrPartition: an undercounting
+        # .query sidecar must fatal, not silently hand uncovered rows
+        # query-0's gradients via the row_slot default of 0
+        if int(self.qb[-1]) != num_data:
+            log.fatal("Sum of query counts is not same with #data")
         label = metadata.label
         check_rank_label(label, len(self.label_gain))
         nq = len(self.qb) - 1
@@ -302,6 +307,19 @@ class LambdarankNDCG(Objective):
             inv[q] = 1.0 / m if m > 0 else m
         self.inverse_max_dcgs = inv
         self.weights = metadata.weights
+        if self.impl == "device":
+            # the [1, Lmax, Lmax] pair tensors (x ~6 f32 temporaries) grow
+            # unbounded in Lmax even at q_block=1; a single 100k-doc query
+            # would need tens of GB of HBM.  Past ~16k docs/query the
+            # reference-order host path is the right tool.
+            qb = np.asarray(self.qb, dtype=np.int64)
+            lmax = int((qb[1:] - qb[:-1]).max()) if len(qb) > 1 else 1
+            if lmax * lmax * 4 * 6 > (1 << 32):   # >4 GB of pair temps
+                log.warning(
+                    "Longest query has %d docs; pair tensors would not fit "
+                    "in HBM. Falling back to rank_impl=native." % lmax)
+                self.impl = "native"
+                self.jax_traceable = False
         if self.impl == "device":
             self._build_device_state()
 

@@ -150,6 +150,114 @@ def leaf_histogram_masked(bins_t: jax.Array, gh2: jax.Array,
     return hist[:f, :max_bin, :]
 
 
+def _hist_kernel_ranged(info_ref, bins_ref, gh_ref, leaf_ref, out_ref):
+    """Ranged variant: info = [target, start_block, n_active] (SMEM).
+
+    The grid's row dimension is the static worst case; steps past
+    n_active revisit the last active block (index maps clamp), so the
+    pipeline skips their DMA, and pl.when skips their matmuls — the cost
+    of an inactive step is grid bookkeeping only.  This is what makes
+    sweep time proportional to the leaf's block range instead of N.
+    """
+    r = pl.program_id(1)
+    feat_block, blk = bins_ref.shape
+    active = r < info_ref[2]
+
+    def emit(init):
+        mask = (leaf_ref[:] == info_ref[0]).astype(jnp.float32)
+        gh3 = jnp.stack([gh_ref[0, :] * mask, gh_ref[1, :] * mask, mask])
+        bins = bins_ref[...].astype(jnp.int32)
+        hi = bins >> 5
+        lo = bins & 31
+        iota_hi = jax.lax.broadcasted_iota(jnp.int32, (N_HI, blk), 0)
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (N_LO, blk), 0)
+        for m in range(feat_block // MM_FEATS):
+            lhs_parts = []
+            rhs_parts = []
+            for f in range(m * MM_FEATS, (m + 1) * MM_FEATS):
+                ohi = (hi[f][None, :] == iota_hi).astype(jnp.float32)
+                lhs_parts.append((gh3[:, None, :] * ohi[None, :, :])
+                                 .reshape(N_COMP * N_HI, blk))
+                rhs_parts.append((lo[f][None, :] == iota_lo)
+                                 .astype(jnp.float32))
+            lhs = jnp.concatenate(lhs_parts, axis=0)
+            rhs = jnp.concatenate(rhs_parts, axis=0)
+            part = jax.lax.dot_general(
+                lhs, rhs, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if init:
+                out_ref[0, m, :, :] = part
+            else:
+                out_ref[0, m, :, :] += part
+
+    @pl.when(r == 0)
+    def _init():
+        emit(True)
+
+    @pl.when((r != 0) & active)
+    def _acc():
+        emit(False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "row_block", "interpret"))
+def leaf_histogram_ranged(bins_t: jax.Array, gh2: jax.Array,
+                          leaf_eff: jax.Array, target_leaf, start_block,
+                          n_active, *, max_bin: int,
+                          row_block: int = PALLAS_ROW_BLOCK,
+                          interpret: bool = False) -> jax.Array:
+    """leaf_histogram_masked restricted to row blocks
+    [start_block, start_block + n_active) — correct whenever every row
+    with leaf_eff == target_leaf lies inside that block range (the
+    ordered-partition invariant; rows of OTHER leaves inside the range
+    are masked out as usual).  start_block/n_active are traced scalars:
+    one compiled kernel serves every leaf range."""
+    f, n = bins_t.shape
+    assert n % row_block == 0, (n, row_block)
+    assert max_bin <= N_HI * N_LO, max_bin
+    fb = _feat_block(f)
+    fpad = ((f + fb - 1) // fb) * fb
+    if fpad != f:
+        bins_t = jnp.pad(bins_t, ((0, fpad - f), (0, 0)))
+    groups = fpad // fb
+    nblocks = n // row_block
+    # n_active >= 1 keeps the clamp and the r==0 init well-defined; an
+    # EMPTY target leaf stays correct because the in-kernel mask
+    # (leaf_eff == target) selects nothing in whatever block is swept
+    info = jnp.stack([jnp.asarray(target_leaf, jnp.int32),
+                      jnp.clip(jnp.asarray(start_block, jnp.int32), 0,
+                               nblocks - 1),
+                      jnp.maximum(jnp.asarray(n_active, jnp.int32), 1)])
+
+    def _rb(r, info_ref):
+        # clamp to the last active block: inactive steps re-request it,
+        # which the pipeline recognizes as "same block, no copy"
+        return info_ref[1] + jnp.minimum(r, info_ref[2] - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(groups, nblocks),
+        in_specs=[
+            pl.BlockSpec((fb, row_block), lambda i, r, s: (i, _rb(r, s))),
+            pl.BlockSpec((2, row_block), lambda i, r, s: (0, _rb(r, s))),
+            pl.BlockSpec((row_block,), lambda i, r, s: (_rb(r, s),)),
+        ],
+        out_specs=pl.BlockSpec((1, fb // MM_FEATS, M_ROWS, N_COLS),
+                               lambda i, r, s: (i, 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _hist_kernel_ranged,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (groups, fb // MM_FEATS, M_ROWS, N_COLS), jnp.float32),
+        interpret=interpret,
+    )(info, bins_t, gh2, leaf_eff)
+    part = out.reshape(-1, MM_FEATS, N_COMP, N_HI, MM_FEATS, N_LO)
+    diag = jnp.einsum("gfchfl->gfchl", part)
+    hist = diag.transpose(0, 1, 3, 4, 2).reshape(fpad, N_HI * N_LO, N_COMP)
+    return hist[:f, :max_bin, :]
+
+
 def leaf_histogram_pallas(bins_t: jax.Array, gh2: jax.Array,
                           mask: jax.Array, *, max_bin: int,
                           row_block: int = PALLAS_ROW_BLOCK,

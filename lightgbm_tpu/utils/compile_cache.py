@@ -8,8 +8,8 @@ well under a second, which is what makes cold-process wall-clock
 competitive (BASELINE.md).
 
 Enabled on package import (see lightgbm_tpu/__init__.py).  Opt out with
-LIGHTGBM_TPU_NO_CACHE=1; override the location with
-LIGHTGBM_TPU_CACHE_DIR.
+LGBM_TPU_NO_COMPILE_CACHE=1 (LIGHTGBM_TPU_NO_CACHE=1 also accepted);
+override the location with LIGHTGBM_TPU_CACHE_DIR.
 """
 
 import os
@@ -17,12 +17,17 @@ import os
 _enabled = False
 
 
+def _cache_disabled() -> bool:
+    return (os.environ.get("LGBM_TPU_NO_COMPILE_CACHE") == "1"
+            or os.environ.get("LIGHTGBM_TPU_NO_CACHE") == "1")
+
+
 def enable_compilation_cache() -> None:
     """Idempotently point JAX's persistent compilation cache at a
     per-user directory and drop the min-size/min-time thresholds so every
     executable (including sub-second ones) is cached."""
     global _enabled
-    if _enabled or os.environ.get("LIGHTGBM_TPU_NO_CACHE") == "1":
+    if _enabled or _cache_disabled():
         return
     try:
         import jax

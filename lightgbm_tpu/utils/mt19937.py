@@ -71,9 +71,16 @@ class Mt19937Random:
         self._pos = 0
 
     def _raw(self, count: int) -> np.ndarray:
-        while len(self._buf) - self._pos < count:
-            self._state, out = _next_block(self._state)
-            self._buf = np.concatenate([self._buf[self._pos :], out])
+        have = len(self._buf) - self._pos
+        if have < count:
+            # generate all missing twist blocks up front: one concatenate
+            # total, not one per 624-word block (quadratic for big draws)
+            blocks = [self._buf[self._pos:]]
+            while have < count:
+                self._state, out = _next_block(self._state)
+                blocks.append(out)
+                have += _N
+            self._buf = np.concatenate(blocks)
             self._pos = 0
         res = self._buf[self._pos : self._pos + count]
         self._pos += count
@@ -102,6 +109,83 @@ class Mt19937Random:
     def next_double(self) -> float:
         return float(self.next_doubles(1)[0])
 
+    def next_ints(self, upper_bounds: np.ndarray) -> np.ndarray:
+        """Sequential NextInt(0, ub) draws, one per entry of upper_bounds
+        (reference random.h:30-40: libstdc++ uniform_int_distribution with
+        a fresh distribution per call).
+
+        libstdc++ (GCC >= 11, including the g++ 12 that builds the
+        reference binary here) downscales a 32-bit urng with Lemire's
+        multiply-shift (bits/uniform_int_dist.h _S_nd, "Fast Random
+        Integer Generation in an Interval"): product = raw * ub;
+        accept unless low32(product) < (2^32 - ub) % ub (redraw on
+        reject); result = product >> 32.  Rejections consume extra raws,
+        shifting every later draw, so the vectorized replay realigns the
+        draw->call mapping to a fixpoint (rejections are rare: the
+        rejected band is < ub/2^32 of the space).
+        """
+        ubs = np.asarray(upper_bounds, dtype=np.uint64)
+        k = len(ubs)
+        out = np.empty(k, dtype=np.int64)
+        two32 = 1 << 32
+        threshold = ((np.uint64(two32) - ubs) % ubs).astype(np.uint64)
+        filled = 0
+        while filled < k:
+            m = k - filled
+            draws = self._raw(m).astype(np.uint64)
+            # map draw position -> call index: a rejected draw repeats
+            # its call, so call[p] = filled + (# accepted before p).
+            # thresholds vary slowly across calls, so iterate to fixpoint.
+            def acc_of(call):
+                prod = draws * ubs[call]
+                low = prod & np.uint64(0xFFFFFFFF)
+                return low >= threshold[call], prod
+
+            acc, _ = acc_of(np.minimum(filled + np.arange(m), k - 1))
+            for _ in range(64):
+                call = filled + np.concatenate(
+                    [[0], np.cumsum(acc[:-1])]).astype(np.int64)
+                call = np.minimum(call, k - 1)
+                new_acc, prod = acc_of(call)
+                if np.array_equal(new_acc, acc):
+                    break
+                acc = new_acc
+            else:   # pathological oscillation: scalar replay of this batch
+                for d in draws:
+                    if filled >= k:
+                        break
+                    p = int(d) * int(ubs[filled])
+                    if (p & 0xFFFFFFFF) >= int(threshold[filled]):
+                        out[filled] = p >> 32
+                        filled += 1
+                continue
+            good = acc & (call < k)
+            out[call[good]] = (prod[good] >> np.uint64(32)).astype(np.int64)
+            filled += int(np.count_nonzero(good))
+        return out
+
+    def _selection_mask(self, n: int, k: int) -> np.ndarray:
+        """Acceptance mask of sequential selection sampling over exactly n
+        NextDouble draws: accept i when draw_i < (k - taken_i) / (n - i).
+
+        The walk is inherently sequential (taken_i depends on every
+        earlier accept), so it runs in the native layer
+        (lgt_selection_mask — the exact IEEE ops of the reference loop);
+        the Python walk is the no-toolchain fallback.
+        """
+        draws = self.next_doubles(n)
+        from .. import native
+        mask = native.selection_mask(draws, k)
+        if mask is not None:
+            return mask
+        mask = np.zeros(n, dtype=bool)
+        taken = 0
+        for i in range(n):
+            if draws[i] < (k - taken) / (n - i):
+                mask[i] = True
+                taken += 1
+        return mask
+
     def sample(self, n: int, k: int) -> np.ndarray:
         """Sequential selection sampling; reference random.h:55-67.
 
@@ -110,15 +194,7 @@ class Mt19937Random:
         """
         if k > n or k < 0:
             return np.zeros(0, dtype=np.int32)
-        draws = self.next_doubles(n)
-        out = np.empty(min(k, n), dtype=np.int32)
-        taken = 0
-        for i in range(n):
-            prob = (k - taken) / (n - i)
-            if draws[i] < prob:
-                out[taken] = i
-                taken += 1
-        return out[:taken]
+        return np.flatnonzero(self._selection_mask(n, k)).astype(np.int32)
 
     def split_mask(self, n: int, k: int) -> np.ndarray:
         """Like sample() but returns the boolean acceptance mask over [0, n).
@@ -126,12 +202,4 @@ class Mt19937Random:
         Mirrors the in/out-of-bag partition loop of GBDT::Bagging
         (reference src/boosting/gbdt.cpp:118-129).
         """
-        draws = self.next_doubles(n)
-        mask = np.zeros(n, dtype=bool)
-        taken = 0
-        for i in range(n):
-            prob = (k - taken) / (n - i)
-            if draws[i] < prob:
-                mask[i] = True
-                taken += 1
-        return mask
+        return self._selection_mask(n, k)

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Measure the primitives for leaf-proportional histogram sweeps (r3).
+
+The round-2 negative result (BASELINE.md "hist_compact") ruled out
+per-split XLA gather compaction.  The remaining design (VERDICT r2 #1)
+is an ORDERED PARTITION: stable-sort rows by leaf at a few scheduled
+points per tree, after which each leaf occupies a contiguous range and a
+sweep touches only its blocks.  Whether that wins is decided by:
+
+  t_rep   = argsort(leaf [N] i32) + take(bins [F,N] u8) + take(gh2)
+  t_sweep(k) = ranged Pallas sweep over k of nblocks row blocks
+               (inactive grid steps revisit the last block: no DMA,
+               no matmul -- cost is the grid-step overhead)
+
+This script times both on the attached TPU with the tunnel-safe slope
+protocol (see scripts/phase_profile.py docstring).  Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("PROFILE_DEVICE"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", os.environ["PROFILE_DEVICE"])
+
+N = int(os.environ.get("BENCH_ROWS", 1 << 20))
+F = int(os.environ.get("PROFILE_FEATS", 28))
+MAX_BIN = 255
+
+
+def _force(out):
+    import jax
+    import jax.numpy as jnp
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def timed(fn, *args, reps=10):
+    out = fn(*args)
+    _force(out)
+    t0 = time.time()
+    out = fn(*args)
+    _force(out)
+    t1 = time.time() - t0
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    tk = time.time() - t0
+    return max((tk - t1) / (reps - 1), 1e-9)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import hist_pallas as hp
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+    res = {"backend": backend, "rows": N, "feats": F}
+    n = (N // hp.PALLAS_ROW_BLOCK) * hp.PALLAS_ROW_BLOCK
+    nblocks = n // hp.PALLAS_ROW_BLOCK
+
+    bins = jnp.asarray(rng.randint(0, MAX_BIN, size=(F, n)), dtype=jnp.uint8)
+    grad = jnp.asarray(rng.randn(n), dtype=jnp.float32)
+    hess = jnp.asarray(rng.rand(n), dtype=jnp.float32)
+    gh2 = jax.jit(hp.make_gh2)(grad, hess)
+    leaf = jnp.asarray(rng.randint(0, 64, size=n), dtype=jnp.int32)
+    interp = backend == "cpu"
+
+    # 1) full masked sweep (the r2 baseline)
+    full = jax.jit(lambda b, g, l: hp.leaf_histogram_masked(
+        b, g, l, jnp.int32(3), max_bin=MAX_BIN, interpret=interp))
+    res["full_sweep_ms"] = round(timed(full, bins, gh2, leaf) * 1e3, 3)
+
+    # 2) ranged sweep at several active-block counts
+    if hasattr(hp, "leaf_histogram_ranged"):
+        for k in (nblocks, 16, 8, 1):
+            fn = jax.jit(lambda b, g, l, k=k: hp.leaf_histogram_ranged(
+                b, g, l, jnp.int32(3), jnp.int32(0), jnp.int32(k),
+                max_bin=MAX_BIN, interpret=interp))
+            res["ranged_%d_ms" % k] = round(timed(fn, bins, gh2, leaf) * 1e3,
+                                            3)
+
+    # 3) reorder primitives
+    srt = jax.jit(lambda x: jnp.argsort(x, stable=True))
+    res["argsort_ms"] = round(timed(srt, leaf) * 1e3, 3)
+    perm = srt(leaf)
+
+    tk_u8 = jax.jit(lambda b, p: jnp.take(b, p, axis=1))
+    res["take_bins_u8_ms"] = round(timed(tk_u8, bins, perm) * 1e3, 3)
+    tk_f32 = jax.jit(lambda g, p: jnp.take(g, p, axis=1))
+    res["take_gh2_f32_ms"] = round(timed(tk_f32, gh2, perm) * 1e3, 3)
+    tk_i32 = jax.jit(lambda l, p: jnp.take(l, p))
+    res["take_leaf_i32_ms"] = round(timed(tk_i32, leaf, perm) * 1e3, 3)
+
+    # sort-pairs alternative to argsort+takes: one lax.sort moving all
+    # payloads (stable; leaf key ascending)
+    def sort_all(l, b, g):
+        ops = (l,) + tuple(b[i] for i in range(F)) + (g[0], g[1])
+        out = jax.lax.sort(ops, num_keys=1, is_stable=True)
+        return out[0], jnp.stack(out[1:1 + F]), jnp.stack(out[1 + F:])
+    res["sort_pairs_ms"] = round(timed(jax.jit(sort_all), leaf, bins, gh2)
+                                 * 1e3, 3)
+
+    # scatter build of an inverse permutation
+    sc = jax.jit(lambda d: jnp.zeros(n, jnp.int32).at[d].set(
+        jnp.arange(n, dtype=jnp.int32)))
+    res["scatter_i32_ms"] = round(timed(sc, perm) * 1e3, 3)
+
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

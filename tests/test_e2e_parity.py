@@ -335,3 +335,43 @@ def test_predict_task_parity(tmp_path, example, test_file, model,
     got = open(out).read()
     want = open(os.path.join(GOLDEN_DIR, golden_out)).read()
     assert got == want
+
+
+@pytest.mark.slow
+def test_binary_two_round_subsampled_parity(tmp_path):
+    """use_two_round_loading=true with bin_construct_sample_cnt < N must
+    reproduce the reference's streaming-reservoir bin sample
+    (TextReader::SampleFromFile, text_reader.h:151-168: mt19937 NextInt
+    per line past the fill, Lemire downscaling per libstdc++) — golden
+    captured from the reference binary with sample_cnt=2000.  All
+    structural lines (incl. every threshold= bin-boundary array) must be
+    byte-identical; float-array lines tolerate the known last-digit
+    summation-order flips."""
+    from lightgbm_tpu.cli import Application
+
+    ex = os.path.join(EXAMPLES, "binary_classification")
+    out = str(tmp_path / "ours2r.txt")
+    Application(["config=" + os.path.join(ex, "train.conf"),
+                 "data=" + os.path.join(ex, "binary.train"),
+                 "valid_data=" + os.path.join(ex, "binary.test"),
+                 "num_trees=20", "hist_dtype=float64",
+                 "use_two_round_loading=true",
+                 "bin_construct_sample_cnt=2000",
+                 "is_save_binary_file=false", "metric_freq=100",
+                 "output_model=" + out]).run()
+    ours = open(out).read().splitlines()
+    want = open(os.path.join(
+        GOLDEN_DIR, "golden_binary_two_round_model.txt")).read().splitlines()
+    assert len(ours) == len(want), "saved model line count differs"
+    for ln, (a, b) in enumerate(zip(ours, want)):
+        if a == b:
+            continue
+        key = a.split("=", 1)[0]
+        assert key in _FLOAT_ARRAY_KEYS, \
+            "line %d differs beyond float tolerance: %r vs %r" % (ln, a, b)
+        assert not a.startswith("threshold="), \
+            "bin boundaries must be byte-identical (line %d)" % ln
+        av = np.array(a.split("=", 1)[1].split(), dtype=np.float64)
+        bv = np.array(b.split("=", 1)[1].split(), dtype=np.float64)
+        np.testing.assert_allclose(av, bv, rtol=1.1e-5, atol=1e-8,
+                                   err_msg="line %d (%s)" % (ln, key))

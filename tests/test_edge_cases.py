@@ -93,3 +93,57 @@ def test_multiclass_two_classes(rng):
     np.testing.assert_allclose(np.asarray(p).reshape(2, -1).sum(axis=0)
                                if p.shape[0] == 2 else p.sum(axis=1),
                                1.0, rtol=1e-5)
+
+
+def test_lambdarank_query_undercount_fatals(rng):
+    """An undercounting .query sidecar must fatal like the reference's
+    Metadata::CheckOrPartition, not silently give uncovered rows the
+    gradients of query 0 / doc 0 via the row_slot default."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    x = rng.randn(50, 3)
+    y = (rng.rand(50) * 3).astype(np.float64)
+    ds = lgb.Dataset(x, label=y)
+    ds.set_group([25, 25])
+    # bypass set_group's own validation to simulate a bad sidecar load
+    ds.inner.metadata.query_boundaries = np.array([0, 20, 40],
+                                                  dtype=np.int64)
+    with pytest.raises(LightGBMError, match="Sum of query counts"):
+        lgb.train({"objective": "lambdarank", "num_leaves": 4,
+                   "min_data_in_leaf": 1, "metric": ""},
+                  ds, num_boost_round=1, verbose_eval=False)
+
+
+def test_compile_cache_documented_optout(monkeypatch):
+    """BASELINE.md documents LGBM_TPU_NO_COMPILE_CACHE as the opt-out; it
+    must actually disable the cache (round-2 doc/flag mismatch)."""
+    import jax
+    from lightgbm_tpu.utils import compile_cache as cc
+    monkeypatch.setenv("LGBM_TPU_NO_COMPILE_CACHE", "1")
+    monkeypatch.setattr(cc, "_enabled", False)
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        cc.enable_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir is None
+        assert cc._enabled is False
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_predict_empty_input_preserves_output(rng, tmp_path):
+    """Streaming predict must not truncate a previous result file before
+    discovering the input is empty (round-2 ADVICE)."""
+    from lightgbm_tpu.cli import main
+    x = rng.randn(80, 4)
+    y = (rng.rand(80) > 0.5).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 4}, x, y)
+    model_p = tmp_path / "model.txt"
+    bst.save_model(str(model_p))
+    empty_p = tmp_path / "empty.tsv"
+    empty_p.write_text("")
+    out_p = tmp_path / "out.txt"
+    out_p.write_text("precious previous result\n")
+    rc = main(["task=predict", "data=%s" % empty_p,
+               "input_model=%s" % model_p, "output_result=%s" % out_p])
+    assert rc != 0
+    assert out_p.read_text() == "precious previous result\n"

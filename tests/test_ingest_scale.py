@@ -25,19 +25,23 @@ def _run(mode_args):
 
 @pytest.mark.slow
 def test_two_round_rss_bounded_vs_one_round():
-    """Loading a 150 MB file two-round must cost well under half the
-    one-round loader's ADDED memory (one-round materializes raw bytes +
-    the parsed f64 matrix; two-round holds one chunk + the uint8 bins)."""
+    """Loading a 150 MB file two-round must stay within a STRUCTURAL
+    memory bound: the uint8 bin matrix (~20 MB at this shape) + label +
+    one 8 MB text chunk + parse state, with generous allocator headroom.
+    An absolute bound, not an RSS ratio — the round-2 version asserted
+    added_two < 0.65 * added_one and flaked when the one-round side's
+    high-water mark shifted under allocator/load noise (VERDICT r2)."""
     two = _run([])
     one = _run(["--one-round"])
     assert two["rows"] == one["rows"] > 500_000
     added_two = two["max_rss_mb"] - two["import_rss_mb"]
     added_one = one["max_rss_mb"] - one["import_rss_mb"]
-    # sanity: both measured something real (one-round materializes raw
-    # bytes + an f64 matrix for a 150 MB file — several hundred MB)
-    assert added_one > 50, (one, two)
-    # generous margin: ru_maxrss is a high-water mark and allocator
-    # behavior shifts a little under system load; the structural claim
-    # (two-round holds one chunk + bins, one-round holds everything)
-    # leaves a wide gap even so
-    assert added_two < 0.65 * added_one, (one, two)
+    # structural bound: bins (~20 MB) + label (~3 MB) + chunk (8 MB) +
+    # reservoir/parse transients; 150 MB leaves ~4x headroom while still
+    # excluding any whole-file materialization (>= 150 MB of raw bytes
+    # alone on the one-round path)
+    assert added_two < 150, (one, two)
+    # weak relative sanity (not load-sensitive at this gap): one-round
+    # materializes raw bytes + an f64 matrix, several hundred MB
+    assert added_one > 150, (one, two)
+    assert added_two < added_one, (one, two)

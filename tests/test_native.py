@@ -281,3 +281,60 @@ def test_ndcg_all_negative_query_unweighted_quirk():
     # query 0 (all-negative) contributes 1.0 (NOT its weight 2); query 1 is
     # perfectly ranked -> weighted 1*1.0.  (1.0 + 1.0) / 3.
     assert abs(got_native[0] - 2.0 / 3.0) < 1e-6
+
+
+def test_parse_bin_dense_mt_threads_equivalent(monkeypatch):
+    """The fused multithreaded parse+bin must produce identical output at
+    any thread count (threads split at line boundaries; outputs land at
+    prefix-summed offsets)."""
+    from lightgbm_tpu import native
+    from lightgbm_tpu.io.binning import find_bin
+    if native.get_lib() is None:
+        pytest.skip("native unavailable")
+    rng = np.random.RandomState(3)
+    rows = 4097
+    vals = rng.randn(rows, 5)
+    y = (rng.rand(rows) > 0.5).astype(int)
+    text = "\n".join(
+        "\t".join([str(y[i])] + ["%.5f" % v for v in vals[i]])
+        for i in range(rows)).encode() + b"\n"
+    mappers = [find_bin(vals[:500, j], 500, 63) for j in range(5)]
+    spec = native.BinSpec(mappers)
+    col_map = np.array([-2, 0, 1, 2, 3, 4], dtype=np.int32)
+
+    outs = []
+    for nt in ("1", "4"):
+        # explicit LGBM_TPU_NUM_THREADS is honored exactly (no small-
+        # buffer clamp), so nt=4 genuinely exercises the cross-thread
+        # split + prefix-offset logic on this 4097-row chunk
+        monkeypatch.setenv("LGBM_TPU_NUM_THREADS", nt)
+        bins = np.zeros((5, rows), dtype=np.uint8)
+        label = np.zeros(rows, dtype=np.float32)
+        got = native.parse_bin_dense_chunk(text, "\t", 6, col_map, spec,
+                                           None, bins, rows, rows, label,
+                                           None, None)
+        assert got == (rows, rows)
+        outs.append((bins, label))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+    # keep-mask path at 4 threads agrees with numpy-selected rows
+    keep = (rng.rand(rows) < 0.4).astype(np.uint8)
+    bins = np.zeros((5, rows), dtype=np.uint8)
+    label = np.zeros(rows, dtype=np.float32)
+    kk, seen = native.parse_bin_dense_chunk(text, "\t", 6, col_map, spec,
+                                            keep, bins, rows, rows, label,
+                                            None, None)
+    assert seen == rows and kk == int(keep.sum())
+    sel = np.flatnonzero(keep)
+    np.testing.assert_array_equal(bins[:, :kk], outs[0][0][:, sel])
+    np.testing.assert_array_equal(label[:kk], outs[0][1][sel])
+    # stale row expectations fatal instead of writing out of bounds
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError, match="changed between loading"):
+        native.parse_bin_dense_chunk(text, "\t", 6, col_map, spec, None,
+                                     bins, rows, rows - 1, label,
+                                     None, None)
+    with pytest.raises(LightGBMError, match="changed between loading"):
+        native.parse_bin_dense_chunk(text, "\t", 6, col_map, spec,
+                                     keep[:rows - 1], bins, rows, rows,
+                                     label, None, None)
