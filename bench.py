@@ -140,6 +140,9 @@ def run_ours():
     train_total_s = time.time() - t_all
     train_s = min(chunk_s) * chunks
 
+    model_path = os.path.join(CACHE, "bench_model.txt")
+    booster.save_model_to_file(-1, True, model_path)
+
     xh, yh = holdout_data()
     pred = booster.predict(xh)[0]
     order = np.argsort(pred)
@@ -150,7 +153,8 @@ def run_ours():
            / (npos * (len(yh) - npos)))
     return {"train_s": train_s, "train_total_s": train_total_s,
             "compile_s": compile_s, "setup_s": setup_s,
-            "auc": float(auc), "backend": jax.default_backend()}
+            "auc": float(auc), "backend": jax.default_backend(),
+            "model_path": model_path}
 
 
 def make_rank_data():
@@ -253,6 +257,75 @@ def run_reference_rank():
     return res
 
 
+def run_ours_bagged():
+    """Bagged + feature-fraction run (VERDICT r2 #3): exercises the
+    packed-mask upload and the device stopped-flag deferral — no
+    per-iteration host sync on this path since round 3."""
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    x, y = make_data()
+    cfg = Config.from_params({**_params(), "bagging_fraction": "0.8",
+                              "bagging_freq": "5",
+                              "feature_fraction": "0.8"})
+    ds = build_dataset(cfg, x, y)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    warm = create_boosting(cfg, ds, obj)
+    warm.train_one_iter(None, None, False)
+    jax.block_until_ready(warm.scores)
+    del warm
+    booster = create_boosting(cfg, ds, obj)
+    t0 = time.time()
+    for _ in range(NUM_TREES):
+        booster.train_one_iter(None, None, False)
+    jax.block_until_ready(booster.scores)
+    float(np.asarray(booster.scores[0, 0]))
+    return {"bagged_train_s": time.time() - t0}
+
+
+def run_reference_bagged():
+    return _run_reference_binary(
+        ["bagging_fraction=0.8", "bagging_freq=5", "feature_fraction=0.8"],
+        "refbag_%dx%d_t%d_l%d_b%d_cpu%d.json" % (
+            N_ROWS, N_FEAT, NUM_TREES, NUM_LEAVES, MAX_BIN, os.cpu_count()),
+        "ref_bagged_train_s")
+
+
+def run_predict_e2e(model_path):
+    """task=predict file-to-file, both sides including parse + predict +
+    format over the SAME 1M-row TSV (VERDICT r2 #6; reference
+    predictor.hpp:82-130)."""
+    exe = ensure_ref_binary()
+    train_file = os.path.join(CACHE, "bench.train")
+    if not os.path.exists(train_file):
+        x, y = make_data()
+        np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
+                   fmt="%.6g", delimiter="\t")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    ours_out = os.path.join(CACHE, "bench_pred_ours.txt")
+    t0 = time.time()
+    subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "task=predict",
+         "data=" + train_file, "input_model=" + model_path,
+         "output_result=" + ours_out],
+        capture_output=True, text=True, check=True, env=env, cwd=CACHE)
+    ours_s = time.time() - t0
+    ref_out = os.path.join(CACHE, "bench_pred_ref.txt")
+    t0 = time.time()
+    subprocess.run(
+        [exe, "task=predict", "data=" + train_file,
+         "input_model=" + model_path, "output_result=" + ref_out],
+        capture_output=True, text=True, check=True, cwd=CACHE)
+    ref_s = time.time() - t0
+    return {"predict_e2e_s": round(ours_s, 3),
+            "ref_predict_e2e_s": round(ref_s, 3),
+            "predict_vs_baseline": round(ref_s / ours_s, 4)}
+
+
 def ensure_ref_binary():
     exe = os.path.join(REF_BUILD, "ref_src", "lightgbm")
     if os.path.exists(exe):
@@ -272,11 +345,8 @@ def ensure_ref_binary():
     return exe
 
 
-def run_reference():
+def _run_reference_binary(extra_args, key, field):
     """Reference binary training seconds (cached per workload+host)."""
-    ncpu = os.cpu_count()
-    key = "ref_%dx%d_t%d_l%d_b%d_cpu%d.json" % (
-        N_ROWS, N_FEAT, NUM_TREES, NUM_LEAVES, MAX_BIN, ncpu)
     cache_f = os.path.join(CACHE, key)
     if os.path.exists(cache_f):
         with open(cache_f) as f:
@@ -294,7 +364,8 @@ def run_reference():
          "num_trees=%d" % NUM_TREES, "num_leaves=%d" % NUM_LEAVES,
          "max_bin=%d" % MAX_BIN, "min_data_in_leaf=%d" % MIN_DATA_IN_LEAF,
          "learning_rate=%g" % LEARNING_RATE, "metric=",
-         "is_save_binary_file=false", "output_model=/dev/null"],
+         "is_save_binary_file=false", "output_model=/dev/null",
+         *extra_args],
         capture_output=True, text=True, cwd=CACHE, check=True)
     last = None
     for line in out.stdout.splitlines():
@@ -304,10 +375,17 @@ def run_reference():
             last = (float(m.group(1)), int(m.group(2)))
     if last is None or last[1] != NUM_TREES:
         raise RuntimeError("could not parse reference timing:\n" + out.stdout)
-    res = {"ref_train_s": last[0], "ncpu": ncpu}
+    res = {field: last[0], "ncpu": os.cpu_count()}
     with open(cache_f, "w") as f:
         json.dump(res, f)
     return res
+
+
+def run_reference():
+    return _run_reference_binary(
+        [], "ref_%dx%d_t%d_l%d_b%d_cpu%d.json" % (
+            N_ROWS, N_FEAT, NUM_TREES, NUM_LEAVES, MAX_BIN,
+            os.cpu_count()), "ref_train_s")
 
 
 def main():
@@ -331,6 +409,25 @@ def main():
             }
         except Exception as e:
             extras = {"rank_error": str(e)[:200]}
+
+    if os.environ.get("BENCH_BAGGED", "1") != "0":
+        try:
+            bo = run_ours_bagged()
+            br = run_reference_bagged()
+            extras.update({
+                "bagged_train_s": round(bo["bagged_train_s"], 3),
+                "ref_bagged_train_s": br["ref_bagged_train_s"],
+                "bagged_vs_baseline": round(
+                    br["ref_bagged_train_s"] / bo["bagged_train_s"], 4),
+            })
+        except Exception as e:
+            extras["bagged_error"] = str(e)[:200]
+
+    if os.environ.get("BENCH_PREDICT", "1") != "0":
+        try:
+            extras.update(run_predict_e2e(ours["model_path"]))
+        except Exception as e:
+            extras["predict_error"] = str(e)[:200]
 
     # headline vs_baseline is the RAW wall-clock ratio (includes any
     # transient tunnel stalls and the post-warm-up residual); the

@@ -77,12 +77,26 @@ class Application:
 
         self.objective = create_objective(cfg)
         start = time.time()
-        self.train_data = load_dataset(cfg.data, cfg, rank=self.rank,
-                                       num_shards=self.num_machines)
+        # feature-parallel premise (reference
+        # feature_parallel_tree_learner.cpp:45-78): every machine holds
+        # ALL rows — only the bin matrix splits, along features.  Rows
+        # then need no sharding, and metrics are already global on every
+        # rank (a cross-rank sum would double-count).
+        feat_parallel = cfg.tree_learner == "feature"
+        row_rank = 0 if feat_parallel else self.rank
+        row_shards = 1 if feat_parallel else self.num_machines
+        if feat_parallel and self.rank > 0:
+            # every rank loads the full file (num_shards=1), so only
+            # rank 0 may write the .bin cache — concurrent writers would
+            # truncate each other on a shared filesystem.  (Mutated
+            # AFTER the config-fingerprint check, which already ran.)
+            cfg.is_save_binary_file = False
+        self.train_data = load_dataset(cfg.data, cfg, rank=row_rank,
+                                       num_shards=row_shards)
         if self.boosting_old is not None:
             self._set_init_scores(self.train_data, cfg.data)
         reducers = None
-        if self.num_machines > 1:
+        if self.num_machines > 1 and not feat_parallel:
             from .parallel.dist import make_metric_reducer
             reducers = make_metric_reducer()
 
@@ -100,7 +114,7 @@ class Application:
             # multi-host: valid files shard per rank like the train file;
             # metric reduction makes the reported values global
             vd = load_dataset(fname, cfg, reference=self.train_data,
-                              rank=self.rank, num_shards=self.num_machines)
+                              rank=row_rank, num_shards=row_shards)
             if self.boosting_old is not None:
                 self._set_init_scores(vd, fname)
             ms = []

@@ -188,6 +188,12 @@ class Config:
     #                                       current TPUs — XLA gather/scatter
     #                                       row selection costs more than the
     #                                       90%-MXU full sweep it avoids)
+    hist_ordered: str = "auto"            # auto | off: ordered-partition mode —
+    #                                       block-list histogram sweeps + rows
+    #                                       re-sorted by the previous tree's
+    #                                       leaves every hist_reorder_every
+    #                                       trees (serial pallas learner)
+    hist_reorder_every: int = 16          # trees between row re-sorts
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
@@ -327,6 +333,8 @@ class Config:
         set_str("hist_agg")
         set_str("rank_impl")
         set_str("hist_compact")
+        set_str("hist_ordered")
+        set_int("hist_reorder_every")
         set_bool("donate_buffers")
         set_str("device_type")
         if c.device_type not in ("", "cpu", "tpu"):
@@ -344,6 +352,9 @@ class Config:
         if c.hist_compact not in ("on", "off"):
             log.fatal("Unknown hist_compact %s (expect on|off)"
                       % c.hist_compact)
+        if c.hist_ordered not in ("auto", "off"):
+            log.fatal("Unknown hist_ordered %s (expect auto|off)"
+                      % c.hist_ordered)
         if c.hist_dtype not in ("float32", "float64"):
             log.fatal("Unknown hist_dtype %s (expect float32|float64)"
                       % c.hist_dtype)

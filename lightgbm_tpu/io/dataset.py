@@ -14,8 +14,10 @@ src/io/dataset_loader.cpp, src/io/metadata.cpp):
   - metadata sidecar files <data>.weight/.query/.init load like
     Metadata::LoadWeights/LoadQueryBoundaries/LoadInitialScore
     (src/io/metadata.cpp:252-327).
-  - the binary cache (`<file>.bin`, dataset_loader.cpp:852-869) is an .npz
-    with the same role (format itself is ours, not byte-compatible).
+  - the binary cache (`<file>.bin`, dataset_loader.cpp:852-869) is the
+    REFERENCE's binary dataset format byte-for-byte since round 3
+    (_save_binary/_load_binary), so datasets interop with the reference
+    binary in both directions like model files already do.
 """
 
 from __future__ import annotations
@@ -136,7 +138,7 @@ def _stream_line_chunks(f, chunk_bytes: int = 32 << 20):
     while True:
         buf = f.read(chunk_bytes)
         if not buf:
-            if carry.strip():
+            if carry.strip(b"\r\n"):
                 yield carry
             return
         buf = (carry + buf).replace(b"\r\n", b"\n").replace(b"\r", b"\n")
@@ -487,8 +489,10 @@ def _load_two_round(filename: str, config: Config, rank: int,
                 row0 += k
                 out0 += kk
                 continue
+            # same non-empty rule as the span scan (any char counts):
+            # a whitespace-only line is a row of empty fields
             chunk = b"\n".join(
-                ln for ln in chunk.split(b"\n") if ln.strip()) + b"\n"
+                ln for ln in chunk.split(b"\n") if ln) + b"\n"
             if chunk == b"\n":
                 continue
             clabel, cfeats, _ = parse_file_bytes(chunk, label_idx, fmt)
@@ -571,7 +575,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
     log.info("Finished loading data file, use %d features with %d data"
              % (ds.num_features, ds.num_data))
     if config.is_save_binary_file and num_shards == 1:
-        _save_binary(ds, filename + ".bin")
+        _save_binary(ds, filename + ".bin", config.num_class)
     return ds
 
 
@@ -589,7 +593,18 @@ def load_dataset(filename: str, config: Config,
     if (reference is None and config.enable_load_from_binary_file
             and os.path.isfile(cache) and num_shards == 1):
         try:
-            return _load_binary(cache)
+            ds = _load_binary(cache)
+            # the reference format carries no label_idx or init scores:
+            # label_idx is config-owned (like the reference, which reads
+            # it from io_config on every load) and init scores reload
+            # from the sidecar (Metadata::LoadInitialScore)
+            ds.label_idx = max(
+                _parse_column_spec(config.label_column, ds.feature_names),
+                0)
+            init = _load_sidecar(filename + ".init")
+            if init is not None:
+                ds.metadata.init_score = init
+            return ds
         except Exception as e:  # corrupt/stale cache: fall through to text
             log.warning("Failed to load binary cache %s: %s" % (cache, e))
 
@@ -777,58 +792,154 @@ def load_dataset(filename: str, config: Config,
              % (ds.num_features, ds.num_data))
 
     if config.is_save_binary_file and num_shards == 1:
-        _save_binary(ds, cache)
+        _save_binary(ds, cache, config.num_class)
     return ds
 
 
-def _save_binary(ds: Dataset, path: str) -> None:
-    arrs = dict(
-        version=np.int32(_BIN_CACHE_VERSION),
-        bins=ds.bins,
-        used_feature_map=ds.used_feature_map,
-        real_feature_index=ds.real_feature_index,
-        num_total_features=np.int32(ds.num_total_features),
-        label_idx=np.int32(ds.label_idx),
-        feature_names=np.asarray(ds.feature_names),
-        label=ds.metadata.label,
-        num_bins=np.asarray([m.num_bin for m in ds.bin_mappers], dtype=np.int32),
-        sparse_rates=np.asarray([m.sparse_rate for m in ds.bin_mappers]),
-    )
-    for i, m in enumerate(ds.bin_mappers):
-        arrs["bounds_%d" % i] = m.bin_upper_bound
-    if ds.metadata.weights is not None:
-        arrs["weights"] = ds.metadata.weights
-    if ds.metadata.query_boundaries is not None:
-        arrs["query_boundaries"] = ds.metadata.query_boundaries
-    if ds.metadata.init_score is not None:
-        arrs["init_score"] = ds.metadata.init_score
+def _save_binary(ds: Dataset, path: str, num_class: int = 1) -> None:
+    """Write the REFERENCE's binary dataset format byte-for-byte
+    (Dataset::SaveBinaryFile, src/io/dataset.cpp:117-180: packed
+    little-endian fwrites — sized header | metadata block
+    (metadata.cpp:375-387) | per-used-feature blocks
+    (feature.h:97-110: feature_index + is_sparse + BinMapper
+    (bin.cpp:189-194) + DenseBin payload (dense_bin.hpp:140-146))), so
+    datasets interop with the reference binary in both directions like
+    model files already do.  Features always serialize dense
+    (SparseBin is a sanctioned deletion, SURVEY §2.1)."""
+    md = ds.metadata
+    n = ds.num_data
+    parts = []
+
+    def u64(v):
+        return np.uint64(v).tobytes()
+
+    def i32(v):
+        return np.int32(v).tobytes()
+
+    header = [i32(n), i32(num_class), i32(ds.num_features),
+              i32(ds.num_total_features),
+              u64(len(ds.used_feature_map)),
+              np.asarray(ds.used_feature_map, dtype=np.int32).tobytes()]
+    for name in ds.feature_names:
+        b = name.encode("utf-8")
+        header += [i32(len(b)), b]
+    header_blob = b"".join(header)
+    parts += [u64(len(header_blob)), header_blob]
+
+    weights = (np.asarray(md.weights, dtype=np.float32)
+               if md.weights is not None else None)
+    qb = (np.asarray(md.query_boundaries, dtype=np.int32)
+          if md.query_boundaries is not None else None)
+    meta = [i32(n), i32(0 if weights is None else len(weights)),
+            i32(0 if qb is None else len(qb) - 1),
+            np.asarray(md.label, dtype=np.float32).tobytes()]
+    if weights is not None:
+        meta.append(weights.tobytes())
+    if qb is not None:
+        meta.append(qb.tobytes())
+    meta_blob = b"".join(meta)
+    parts += [u64(len(meta_blob)), meta_blob]
+
+    for inner in range(ds.num_features):
+        m = ds.bin_mappers[inner]
+        bounds = np.asarray(m.bin_upper_bound, dtype=np.float64)
+        val_t = np.uint8 if m.num_bin <= 256 else np.uint16
+        feat = b"".join([
+            i32(int(ds.real_feature_index[inner])),
+            b"\x00",                      # is_sparse = false
+            i32(m.num_bin),
+            b"\x01" if m.is_trivial else b"\x00",
+            np.float64(m.sparse_rate).tobytes(),
+            bounds.tobytes(),
+            np.ascontiguousarray(ds.bins[inner], dtype=val_t).tobytes(),
+        ])
+        parts += [u64(len(feat)), feat]
     with open(path, "wb") as f:
-        np.savez_compressed(f, **arrs)
-    log.info("Saved binary dataset cache to %s" % path)
+        for p in parts:       # stream: no second full-file copy in RAM
+            f.write(p)
+    log.info("Saved data to binary file %s" % path)
+
+
+class _BinReader:
+    def __init__(self, blob: bytes):
+        self.b = blob
+        self.o = 0
+
+    def take(self, dtype, count=1):
+        a = np.frombuffer(self.b, dtype=dtype, count=count, offset=self.o)
+        self.o += a.nbytes
+        return a
+
+    def raw(self, nbytes: int) -> bytes:
+        r = self.b[self.o:self.o + nbytes]
+        self.o += nbytes
+        return r
 
 
 def _load_binary(path: str) -> Dataset:
-    z = np.load(path, allow_pickle=False)
-    if int(z["version"]) != _BIN_CACHE_VERSION:
-        raise ValueError("bin cache version mismatch")
-    num_bins = z["num_bins"]
-    sparse = z["sparse_rates"]
-    mappers = [BinMapper(bin_upper_bound=z["bounds_%d" % i],
-                         num_bin=int(num_bins[i]), is_trivial=False,
-                         sparse_rate=float(sparse[i]))
-               for i in range(len(num_bins))]
-    metadata = Metadata(
-        label=z["label"],
-        weights=z["weights"] if "weights" in z else None,
-        query_boundaries=z["query_boundaries"] if "query_boundaries" in z else None,
-        init_score=z["init_score"] if "init_score" in z else None)
+    """Read the reference binary dataset format (the inverse of
+    _save_binary; reference DatasetLoader::LoadFromBinFile,
+    src/io/dataset_loader.cpp:247-406) — including files the reference
+    binary itself wrote, as long as every feature serialized dense."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    r = _BinReader(blob)
+    hsize = int(r.take(np.uint64)[0])
+    h = _BinReader(r.raw(hsize))
+    n = int(h.take(np.int32)[0])
+    h.take(np.int32)                      # num_class (config-owned here)
+    num_features = int(h.take(np.int32)[0])
+    num_total = int(h.take(np.int32)[0])
+    n_map = int(h.take(np.uint64)[0])
+    used_feature_map = h.take(np.int32, n_map).copy()
+    names = []
+    for _ in range(num_total):
+        ln = int(h.take(np.int32)[0])
+        names.append(h.raw(ln).decode("utf-8", "replace"))
+
+    msize = int(r.take(np.uint64)[0])
+    m = _BinReader(r.raw(msize))
+    mn = int(m.take(np.int32)[0])
+    if mn != n:
+        raise ValueError("metadata row count mismatch")
+    n_w = int(m.take(np.int32)[0])
+    n_q = int(m.take(np.int32)[0])
+    label = m.take(np.float32, n).copy()
+    weights = m.take(np.float32, n_w).copy() if n_w else None
+    qb = m.take(np.int32, n_q + 1).copy() if n_q else None
+
+    mappers: List[BinMapper] = []
+    real_index = []
+    rows = []
+    for _ in range(num_features):
+        fsize = int(r.take(np.uint64)[0])
+        fb = _BinReader(r.raw(fsize))
+        real_index.append(int(fb.take(np.int32)[0]))
+        if fb.raw(1) != b"\x00":
+            raise ValueError("sparse feature sections are not supported "
+                             "(is_enable_sparse data)")
+        num_bin = int(fb.take(np.int32)[0])
+        trivial = fb.raw(1) != b"\x00"
+        sparse_rate = float(fb.take(np.float64)[0])
+        bounds = fb.take(np.float64, num_bin).copy()
+        val_t = np.uint8 if num_bin <= 256 else np.uint16
+        rows.append(fb.take(val_t, n).copy())
+        mappers.append(BinMapper(bin_upper_bound=bounds, num_bin=num_bin,
+                                 is_trivial=trivial,
+                                 sparse_rate=sparse_rate))
+    dtype = (np.uint16 if any(m_.num_bin > 256 for m_ in mappers)
+             else np.uint8)
+    bins = np.zeros((num_features, n), dtype=dtype)
+    for i, row in enumerate(rows):
+        bins[i] = row
+    metadata = Metadata(label=label, weights=weights,
+                        query_boundaries=qb)
     metadata.finish_queries()
-    ds = Dataset(bins=z["bins"], bin_mappers=mappers,
-                 used_feature_map=z["used_feature_map"],
-                 real_feature_index=z["real_feature_index"],
-                 num_total_features=int(z["num_total_features"]),
-                 feature_names=[str(s) for s in z["feature_names"]],
-                 metadata=metadata, label_idx=int(z["label_idx"]))
-    log.info("Loaded binary dataset cache from %s (%d features, %d rows)"
+    ds = Dataset(bins=bins, bin_mappers=mappers,
+                 used_feature_map=used_feature_map,
+                 real_feature_index=np.asarray(real_index, dtype=np.int32),
+                 num_total_features=num_total, feature_names=names,
+                 metadata=metadata)
+    log.info("Loaded binary dataset file %s (%d features, %d rows)"
              % (path, ds.num_features, ds.num_data))
     return ds

@@ -191,7 +191,10 @@ def _native_parse(lines: List[str], label_idx: int, fmt: str):
 def parse_file_lines(lines: List[str], label_idx: int,
                      fmt: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray, str]:
-    lines = [ln for ln in lines if ln.strip()]
+    # non-empty = has any non-EOL character, like the native scanner and
+    # the reference's TextReader (whitespace-only lines are rows of
+    # empty fields -> 0.0); .strip() here would diverge the row counts
+    lines = [ln for ln in lines if ln.strip("\r\n")]
     if not lines:
         log.fatal("Data file is empty")
     fmt = fmt or detect_format(lines)

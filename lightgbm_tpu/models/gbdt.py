@@ -41,12 +41,16 @@ class _PendingTree:
     flight); GBDT._flush_pending unpacks batches of these into host Trees
     without blocking the per-iteration dispatch pipeline."""
 
-    __slots__ = ("ints", "floats", "lr")
+    __slots__ = ("ints", "floats", "lr", "gated")
 
-    def __init__(self, ints, floats, lr):
+    def __init__(self, ints, floats, lr, gated=False):
+        # gated: produced by the fused step, whose device stopped-flag
+        # already suppressed this tree's score updates if it came after
+        # a stump — _flush_pending must NOT subtract it again
         self.ints = ints
         self.floats = floats
         self.lr = lr
+        self.gated = gated
 
 
 @jax.jit
@@ -80,14 +84,35 @@ _FUSED_STEPS = OrderedDict()
 _FUSED_STEPS_MAX = 8
 
 
+def _unpack_bag(bag_mask, n_pad):
+    """Bag masks upload as packed bits ([n_pad/8] u8, np.packbits big-
+    endian bit order) — 8x less host->device traffic per re-bagging,
+    which matters on remote-attached TPUs.  Bool masks pass through."""
+    if bag_mask.dtype == jnp.uint8:
+        bits = (bag_mask[:, None]
+                >> (jnp.uint8(7) - jnp.arange(8, dtype=jnp.uint8))) \
+            & jnp.uint8(1)
+        return bits.reshape(-1)[:n_pad].astype(bool)
+    return bag_mask
+
+
 def _make_fused_step(grad_fn, grow_kw, lr, dtype):
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
-             gstate):
+             gstate, stopped):
+        bag = _unpack_bag(bag_mask, bins.shape[1])
         grad, hess = grad_fn(scores[0], gstate)
         dev_tree, leaf_id = grow_tree(
             bins, grad.astype(dtype), hess.astype(dtype),
-            bag_mask, fmask, **grow_kw)
-        leaf_vals = (dev_tree.leaf_value * lr).astype(jnp.float32)
+            bag, fmask, **grow_kw)
+        # deferred stump stop: once any tree fails to split, every later
+        # step no-ops its score updates, so a late host flush truncates
+        # at the exact reference stop point (gbdt.cpp:186) with scores
+        # untouched past it — no per-iteration host sync needed even
+        # with bagging/feature_fraction
+        live = jnp.logical_not(stopped)
+        stopped = stopped | (dev_tree.num_leaves <= 1)
+        leaf_vals = jnp.where(live, dev_tree.leaf_value * lr,
+                              0.0).astype(jnp.float32)
         scores = scores.at[0].add(leaf_vals[leaf_id])
         new_valid = []
         for vs, vbins in zip(valid_scores, valid_bins):
@@ -96,8 +121,52 @@ def _make_fused_step(grad_fn, grow_kw, lr, dtype):
                 dev_tree.left_child, dev_tree.right_child, vbins)
             new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
         ints, floats = _pack_tree(dev_tree)
-        return scores, new_valid, ints, floats
+        return scores, new_valid, ints, floats, stopped
     return jax.jit(step, donate_argnums=(0, 1))
+
+
+def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype):
+    """The fused step PLUS the ordered-partition row re-sort: after the
+    tree lands, rows are stably re-sorted by its leaf assignment so later
+    trees' leaves stay block-clustered and the block-list sweeps
+    (ops/grow.py ranged mode) touch few blocks.  Everything per-row
+    (bins, scores, bag mask, objective state, the composed row order)
+    comes back permuted in the SAME dispatch; valid sets and tree output
+    are row-order-free."""
+    def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
+             gstate, row_order, stopped):
+        bag = _unpack_bag(bag_mask, bins.shape[1])
+        grad, hess = grad_fn(scores[0], gstate)
+        dev_tree, leaf_id = grow_tree(
+            bins, grad.astype(dtype), hess.astype(dtype),
+            bag, fmask, **grow_kw)
+        live = jnp.logical_not(stopped)
+        stopped = stopped | (dev_tree.num_leaves <= 1)
+        leaf_vals = jnp.where(live, dev_tree.leaf_value * lr,
+                              0.0).astype(jnp.float32)
+        scores = scores.at[0].add(leaf_vals[leaf_id])
+        new_valid = []
+        for vs, vbins in zip(valid_scores, valid_bins):
+            vleaf = predict_leaf_binned(
+                dev_tree.split_feature, dev_tree.threshold_bin,
+                dev_tree.left_child, dev_tree.right_child, vbins)
+            new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
+        ints, floats = _pack_tree(dev_tree)
+        # stable sort by this tree's leaves; padded rows ride along via
+        # their tracked leaf_id and stay permanently out-of-bag through
+        # the permuted bag mask
+        rel = jnp.argsort(leaf_id, stable=True).astype(jnp.int32)
+        bins_new = jnp.take(bins, rel, axis=1)
+        scores = jnp.take(scores, rel, axis=1)
+        bag_new = jnp.take(bag, rel)
+        gstate_new = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, rel, axis=-1), gstate)
+        order_new = jnp.take(row_order, rel)
+        return (scores, new_valid, ints, floats, bins_new, bag_new,
+                gstate_new, order_new, stopped)
+    # gstate is NOT donated: on the first re-sort it aliases the
+    # objective's own arrays, which must stay valid for metrics/restarts
+    return jax.jit(step, donate_argnums=(0, 1, 2, 4, 7))
 
 
 class GBDT:
@@ -191,6 +260,7 @@ class GBDT:
         self.grower = None
         self.rows_sharded = False
         self._mh = False
+        self._feat_mh = False
         if config.tree_learner in ("data", "voting"):
             from ..parallel.mesh import ShardedGrower, make_mesh
             mesh = make_mesh(config.num_shards)
@@ -215,9 +285,11 @@ class GBDT:
                 all_n = process_allgather(np.asarray([n], dtype=np.int64))
                 self._n_pad_base = int(np.max(all_n))
         elif config.tree_learner == "feature":
-            if jax.process_count() > 1:
-                log.fatal("tree_learner=feature is single-host only; "
-                          "use tree_learner=data for multi-host training")
+            # multi-host feature parallel since round 3 (the reference's
+            # multi-machine FeatureParallelTreeLearner): every process
+            # loads ALL rows (cli.init_train forces row_shards=1), the
+            # bin matrix splits along F across all hosts' devices, and
+            # the best-split all-gather + argmax crosses hosts over DCN
             from ..parallel.mesh import (FeatureShardedGrower, make_mesh,
                                          FEATURE_AXIS)
             mesh = make_mesh(config.num_shards, FEATURE_AXIS)
@@ -225,6 +297,7 @@ class GBDT:
                 mesh, max_leaves=max(config.num_leaves, 2),
                 max_bin=self.max_bin, params=self.params,
                 max_depth=config.max_depth, hist_impl=impl)
+            self._feat_mh = jax.process_count() > 1
         # bounded histogram working set (the reference HistogramPool's
         # role, feature_histogram.hpp:275-398): translate the MB budget
         # into a slot count of [F, max_bin, 3] leaf histograms for the
@@ -255,6 +328,24 @@ class GBDT:
             half = max(self.n_pad // 2, 1)
             self.hist_compact = ((half + row_unit - 1)
                                  // row_unit) * row_unit
+
+        # ordered-partition growth (serial pallas learner): block-list
+        # sweeps are always on (bit-identical to full sweeps for a fixed
+        # row order — empty blocks contribute exact zeros); the row
+        # re-sort that makes them leaf-proportional additionally needs
+        # the fused path, a permutable objective, and no bagging (the
+        # in/out-of-bag draw is pinned to ORIGINAL row order)
+        self.hist_ranged = (config.hist_ordered != "off"
+                            and impl == "pallas" and self.grower is None)
+        if config.hist_compact == "on" and self.hist_ranged:
+            log.warning("hist_compact=on disables hist_ordered "
+                        "(mutually exclusive row-selection strategies)")
+            self.hist_ranged = False
+        self.reorder_every = max(int(config.hist_reorder_every), 1)
+        self._row_order = None        # [n_pad] i32 device; None = identity
+        self._inv_order = None        # cached device inverse of the above
+        self._gstate_override = None
+        self._trees_since_reorder = 0
 
         bins = train_data.bins
         if self.n_pad != n:
@@ -290,9 +381,22 @@ class GBDT:
         # too (dropping needs host trees each iteration), and
         # train_one_iter forces a flush when gradients come from a custom
         # objective (their evolution is outside the soundness argument).
-        deferrable = (self.num_class == 1 and not self.bagging_enabled
-                      and config.feature_fraction >= 1.0)
+        # Since round 3, deferral is sound for bagged/feature-fraction
+        # runs too: the fused step carries a DEVICE stopped flag — after
+        # the first stump every later step no-ops its score updates, so
+        # a late flush truncates at the exact reference stop point with
+        # scores untouched past it (the earlier host-sync-per-iteration
+        # requirement is gone).  Multiclass still flushes per iteration
+        # (general path, per-class trees).
+        # The general (non-fused) path has no device flag and still needs
+        # the old soundness condition (no bagging / feature_fraction);
+        # DART re-forces 1 in its own __init__.
+        deferrable = (self.num_class == 1
+                      and (self._can_fuse()
+                           or (not self.bagging_enabled
+                               and config.feature_fraction >= 1.0)))
         self._flush_every = 16 if deferrable else 1
+        self._dev_stopped = jnp.asarray(False)
         self.bag_rng = Mt19937Random(config.bagging_seed)
         self.bag_masks = []
         for _ in range(self.num_class):
@@ -301,6 +405,7 @@ class GBDT:
             self.bag_masks.append(m)
         # sharded/device bag masks are cached; _bagging invalidates
         self._bag_dev = [None] * self.num_class
+        self._bag_dev_packed = [None] * self.num_class
         # per-class feature-fraction RNG, all seeded feature_fraction_seed
         # (one TreeLearner per class in the reference, gbdt.cpp:38-45)
         self.feat_rngs = [Mt19937Random(config.feature_fraction_seed)
@@ -358,6 +463,7 @@ class GBDT:
         padded[:n] = mask
         self.bag_masks[cls] = padded
         self._bag_dev[cls] = None
+        self._bag_dev_packed[cls] = None
         log.debug("Re-bagging, using %d data to train" % int(mask.sum()))
 
     def _feature_mask(self, cls: int) -> np.ndarray:
@@ -383,8 +489,12 @@ class GBDT:
             self._bagging(self.iter, 0)
             fmask = self._feature_mask(0)
             self._models.append(self._run_fused(
-                self._bag_mask_dev(0), jnp.asarray(fmask)))
+                self._bag_mask_dev_packed(0), jnp.asarray(fmask)))
         else:
+            # leaving the fused path (custom gradients / objective swap):
+            # gradients arrive in FILE order, so per-row state must be
+            # restored to file order first or rows and gradients misalign
+            self._restore_row_order()
             if gradients is None or hessians is None:
                 grad, hess = self.objective.get_gradients(
                     self._score_for_gradients())
@@ -435,6 +545,16 @@ class GBDT:
                 self._bag_dev[cls] = jnp.asarray(mask)
         return self._bag_dev[cls]
 
+    def _bag_mask_dev_packed(self, cls: int):
+        """Bit-packed bag mask for the fused step (8x less transfer per
+        re-bagging; the step unpacks on device).  The ordered-partition
+        re-sort replaces this cache with an already-permuted bool mask —
+        _unpack_bag passes bool through."""
+        if self._bag_dev_packed[cls] is None:
+            self._bag_dev_packed[cls] = jnp.asarray(
+                np.packbits(self.bag_masks[cls]))
+        return self._bag_dev_packed[cls]
+
     def _can_fuse(self) -> bool:
         """The fused single-dispatch iteration covers the serial single-
         class path with a jax-traceable objective (regression/binary);
@@ -446,13 +566,25 @@ class GBDT:
                 and getattr(self.objective, "jax_traceable", False)
                 and self.objective.fused_key() is not None)
 
+    def _reorder_enabled(self) -> bool:
+        return (self.hist_ranged and not self.bagging_enabled
+                and getattr(self.objective, "row_permutable", False)
+                and self._can_fuse())
+
     def _run_fused(self, bag_mask_dev, fmask_dev) -> "_PendingTree":
         cfg = self.config
         lr = self.shrinkage_rate
+        # re-sort after the FIRST tree (clustering pays from tree 2 on),
+        # then every reorder_every trees
+        reorder = (self._reorder_enabled()
+                   and self._trees_since_reorder
+                   >= (0 if self._row_order is None
+                       else self.reorder_every - 1))
         key = (self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
-               self.hist_slots, self.hist_compact)
+               self.hist_slots, self.hist_compact, self.hist_ranged,
+               reorder)
         fn = _FUSED_STEPS.get(key)
         if fn is None:
             grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
@@ -460,18 +592,39 @@ class GBDT:
                            max_depth=cfg.max_depth,
                            hist_impl=self.hist_impl,
                            hist_slots=self.hist_slots,
-                           compact=self.hist_compact)
-            fn = _make_fused_step(self.objective.make_grad_fn(), grow_kw,
-                                  lr, self.dtype)
+                           compact=self.hist_compact,
+                           ranged=self.hist_ranged)
+            make = (_make_fused_step_reorder if reorder
+                    else _make_fused_step)
+            fn = make(self.objective.make_grad_fn(), grow_kw, lr,
+                      self.dtype)
             _FUSED_STEPS[key] = fn
             if len(_FUSED_STEPS) > _FUSED_STEPS_MAX:
                 _FUSED_STEPS.popitem(last=False)
         else:
             _FUSED_STEPS.move_to_end(key)
-        scores, valid, ints, floats = fn(
-            self.scores, list(self.valid_scores), bag_mask_dev, fmask_dev,
-            self.bins_dev, tuple(self.valid_bins_dev),
-            self.objective.grad_state())
+        gstate = (self._gstate_override if self._gstate_override is not None
+                  else self.objective.grad_state())
+        if reorder:
+            order = (self._row_order if self._row_order is not None
+                     else jnp.arange(self.n_pad, dtype=jnp.int32))
+            (scores, valid, ints, floats, bins_new, bag_new, gstate_new,
+             order_new, self._dev_stopped) = fn(
+                self.scores, list(self.valid_scores), bag_mask_dev,
+                fmask_dev, self.bins_dev, tuple(self.valid_bins_dev),
+                gstate, order, self._dev_stopped)
+            self.bins_dev = bins_new
+            self._bag_dev_packed[0] = bag_new
+            self._gstate_override = gstate_new
+            self._row_order = order_new
+            self._inv_order = None
+            self._trees_since_reorder = 0
+        else:
+            scores, valid, ints, floats, self._dev_stopped = fn(
+                self.scores, list(self.valid_scores), bag_mask_dev,
+                fmask_dev, self.bins_dev, tuple(self.valid_bins_dev),
+                gstate, self._dev_stopped)
+            self._trees_since_reorder += 1
         self.scores = scores
         self.valid_scores = list(valid)
         for a in (ints, floats):
@@ -479,7 +632,7 @@ class GBDT:
                 a.copy_to_host_async()
             except AttributeError:
                 pass
-        return _PendingTree(ints, floats, lr)
+        return _PendingTree(ints, floats, lr, gated=True)
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
@@ -496,6 +649,18 @@ class GBDT:
                 self.grower.replicate(fmask))
             dev_tree = self.grower.replicated_to_local(dev_tree)
             leaf_id = self.grower.local_rows(leaf_id)
+        elif self.grower is not None and self._feat_mh:
+            # feature-parallel across hosts: rows replicated (every
+            # process computes identical grad/hess on its full local
+            # copy), features split; pull the replicated outputs local
+            g = self.grower.shard_rows(
+                np.asarray(grad, dtype=self.dtype), self.n_pad)
+            h = self.grower.shard_rows(
+                np.asarray(hess, dtype=self.dtype), self.n_pad)
+            dev_tree, leaf_id = self.grower.grow(
+                self.bins_dev, g, h, bag_mask_dev, fmask)
+            dev_tree = self.grower.replicated_to_local(dev_tree)
+            leaf_id = self.grower.local_replicated(leaf_id)
         elif self.grower is not None:
             dev_tree, leaf_id = self.grower.grow(
                 self.bins_dev, grad.astype(self.dtype),
@@ -508,7 +673,7 @@ class GBDT:
                 max_leaves=max(cfg.num_leaves, 2), max_bin=self.max_bin,
                 params=self.params, max_depth=cfg.max_depth,
                 hist_impl=self.hist_impl, hist_slots=self.hist_slots,
-                compact=self.hist_compact)
+                compact=self.hist_compact, ranged=self.hist_ranged)
 
         lr = self.shrinkage_rate
         # train-score update: leaf_value[leaf_id] gather for ALL rows —
@@ -565,9 +730,11 @@ class GBDT:
         num_used_model_ = size/num_class, gbdt.cpp:455,489).  Returns True
         when training must stop."""
         stop_at = None
+        gated_flags = {}
         for idx, m in enumerate(self._models):
             if not isinstance(m, _PendingTree):
                 continue
+            gated_flags[idx] = m.gated
             tree = self._unpack_tree(m)
             self._models[idx] = tree
             if tree.num_leaves <= 1 and stop_at is None:
@@ -575,7 +742,10 @@ class GBDT:
         if stop_at is not None:
             for idx in range(stop_at, len(self._models)):
                 t = self._models[idx]
-                if t.num_leaves > 1:
+                # fused-step trees past the stump were grown with the
+                # device stopped flag set: their score updates were
+                # already suppressed on device, nothing to subtract
+                if t.num_leaves > 1 and not gated_flags.get(idx, False):
                     self._subtract_tree_scores(t, idx % self.num_class)
             del self._models[stop_at:]
             self._stopped = True
@@ -649,8 +819,41 @@ class GBDT:
         tree.shrinkage(p.lr)
         return tree
 
+    def _inverse_row_order(self):
+        """Device [n_pad] inverse permutation of the ordered-partition
+        row order (cached between re-sorts), or None for identity."""
+        if self._row_order is None:
+            return None
+        if self._inv_order is None:
+            self._inv_order = jnp.argsort(self._row_order)
+        return self._inv_order
+
+    def _restore_row_order(self) -> None:
+        """Return all per-row state to FILE order (leaving the fused
+        ordered-partition path: custom gradients, objective swaps)."""
+        if self._row_order is None:
+            return
+        inv = self._inverse_row_order()
+        self.scores = jnp.take(self.scores, inv, axis=1)
+        bins = self.train_data.bins
+        if self.n_pad != self.num_data:
+            bins = np.pad(bins, ((0, 0), (0, self.n_pad - self.num_data)))
+        self.bins_dev = jnp.asarray(bins)
+        self._bag_dev = [None] * self.num_class
+        self._bag_dev_packed = [None] * self.num_class
+        self._row_order = None
+        self._inv_order = None
+        self._gstate_override = None
+        self._trees_since_reorder = 0
+
     def _training_score(self):
-        s = self.scores[:, :self.num_data]
+        s = self.scores
+        inv = self._inverse_row_order()
+        if inv is not None:
+            # ordered-partition mode keeps per-row state sorted by tree
+            # leaves; metrics (and any external reader) see file order
+            s = jnp.take(s, inv, axis=1)
+        s = s[:, :self.num_data]
         return s[0] if self.num_class == 1 else s
 
     def _score_for_gradients(self):
@@ -1028,15 +1231,27 @@ class GBDT:
         early-stopping bookkeeping and mt19937 stream positions.
         Resuming from it continues training bit-for-bit."""
         self._flush_pending()
+        # ordered-partition mode keeps scores leaf-sorted; checkpoints
+        # store FILE order plus the row order itself, so a restored
+        # booster reconstructs the exact permuted state and resumes
+        # bit-for-bit
+        scores = np.asarray(self.scores)
+        inv = self._inverse_row_order()
+        if inv is not None:
+            scores = scores[:, np.asarray(inv)]
         arrays = {
             "iter": np.int64(self.iter),
             "num_used_model": np.int64(self.num_used_model),
             "stopped": np.int64(self._stopped),
-            "scores": np.asarray(self.scores),
+            "scores": scores,
             "bag_masks": np.stack(self.bag_masks),
             "num_valid_sets": np.int64(len(self.best_iter)),
             "num_trees": np.int64(len(self._models)),
         }
+        if self._row_order is not None:
+            arrays["row_order"] = np.asarray(self._row_order)
+            arrays["trees_since_reorder"] = np.int64(
+                self._trees_since_reorder)
         # per-valid-set keys: metric counts can differ between valid sets,
         # so one rectangular [sets, metrics] array would be ragged
         for i in range(len(self.best_iter)):
@@ -1061,12 +1276,46 @@ class GBDT:
         z = np.load(path)
         self.iter = int(z["iter"])
         self._stopped = bool(z["stopped"])
-        self.scores = jnp.asarray(z["scores"])
+        self._dev_stopped = jnp.asarray(self._stopped)
+        # checkpointed per-row state is in FILE order; when the snapshot
+        # carries an ordered-partition row order, rebuild the exact
+        # permuted state (bins/scores/objective state) so training
+        # resumes bit-for-bit on the same accumulation order
+        bins = self.train_data.bins if self.train_data is not None else None
+        if bins is not None and self.n_pad != self.num_data:
+            bins = np.pad(bins, ((0, 0), (0, self.n_pad - self.num_data)))
+        if "row_order" in z:
+            order = np.asarray(z["row_order"])
+            self._row_order = jnp.asarray(order, dtype=jnp.int32)
+            self._trees_since_reorder = int(z["trees_since_reorder"])
+            self.bins_dev = jnp.asarray(bins[:, order])
+            self._gstate_override = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, self._row_order, axis=-1),
+                self.objective.grad_state()) \
+                if getattr(self.objective, "row_permutable", False) else None
+            z_scores = np.asarray(z["scores"])[:, order]
+            bag_restored = True
+        else:
+            if self._row_order is not None and bins is not None:
+                self.bins_dev = jnp.asarray(bins)
+            self._row_order = None
+            self._trees_since_reorder = 0
+            self._gstate_override = None
+            z_scores = np.asarray(z["scores"])
+            bag_restored = False
+        self._inv_order = None
+        self.scores = jnp.asarray(z_scores)
         if self.grower is not None and self.rows_sharded and not self._mh:
             self.scores = jax.device_put(self.scores,
                                          self.grower.row_sharding_2d())
         self.bag_masks = [m.copy() for m in z["bag_masks"]]
         self._bag_dev = [None] * self.num_class
+        self._bag_dev_packed = [None] * self.num_class
+        if bag_restored:
+            # the fused-path device bag mask must follow the restored row
+            # order (host bag_masks stay in file order like everything host)
+            self._bag_dev_packed[0] = jnp.asarray(
+                self.bag_masks[0][np.asarray(self._row_order)])
         if "num_valid_sets" in z:
             nv = int(z["num_valid_sets"])
             self.best_iter = [[int(v) for v in z["best_iter_%d" % i]]

@@ -224,6 +224,7 @@ inline std::vector<const char*> split_at_lines(const char* buf, int64_t len,
   for (int t = 1; t < nt; ++t) {
     const char* p = buf + len * t / nt;
     if (p <= cuts[t - 1]) p = cuts[t - 1];
+    if (p == buf) p = buf + 1;   // p[-1] below must stay in bounds
     // advance to the first line start at/after p
     while (p < end && !is_eol(p[-1])) ++p;
     cuts[t] = p;
@@ -663,6 +664,11 @@ int64_t lgt_parse_dense(const char* buf, int64_t len, char sep, double* out,
   return r;
 }
 
+// Feature indices above this are treated as malformed tokens and
+// skipped (the reference parses them through atoi into int, UB there;
+// a bound keeps a corrupt file from requesting a 2^63-column matrix).
+constexpr int64_t kMaxFeatureIdx = (int64_t(1) << 31) - 1;
+
 // Scan a libsvm buffer: rows and the maximum feature index seen.
 void lgt_scan_libsvm(const char* buf, int64_t len, int64_t* rows_out,
                      int64_t* max_idx_out) {
@@ -680,7 +686,7 @@ void lgt_scan_libsvm(const char* buf, int64_t len, int64_t* rows_out,
           while (b > p && b[-1] >= '0' && b[-1] <= '9') --b;
           if (b < s) {
             int64_t idx = std::strtoll(b, nullptr, 10);
-            if (idx > max_idx) max_idx = idx;
+            if (idx > max_idx && idx <= kMaxFeatureIdx) max_idx = idx;
           }
         }
       }

@@ -35,6 +35,11 @@ class Objective:
     # True when get_gradients is pure jax over captured device arrays and
     # may be traced inside a fused training step (models/gbdt.py)
     jax_traceable = False
+    # True when every grad_state leaf is a per-row array whose LAST axis
+    # may be permuted to follow a row reordering (the ordered-partition
+    # mode, models/gbdt.py); row-structured objectives (lambdarank's
+    # query blocks hold row INDICES) must leave this False
+    row_permutable = False
     name = "none"
     num_class = 1
 
@@ -88,6 +93,7 @@ class Objective:
 class RegressionL2(Objective):
     name = "regression"
     jax_traceable = True
+    row_permutable = True
 
     def __init__(self, config: Config):
         pass
@@ -129,6 +135,7 @@ class RegressionL2(Objective):
 class BinaryLogloss(Objective):
     name = "binary"
     jax_traceable = True
+    row_permutable = True
 
     def __init__(self, config: Config):
         self.sigmoid = np.float32(config.sigmoid)

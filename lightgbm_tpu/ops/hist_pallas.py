@@ -150,14 +150,15 @@ def leaf_histogram_masked(bins_t: jax.Array, gh2: jax.Array,
     return hist[:f, :max_bin, :]
 
 
-def _hist_kernel_ranged(info_ref, bins_ref, gh_ref, leaf_ref, out_ref):
-    """Ranged variant: info = [target, start_block, n_active] (SMEM).
+def _hist_body(info_ref, bins_ref, gh_ref, leaf_ref, out_ref):
+    """Shared body of the ranged/blocklist kernels: info = [target, _,
+    n_active] (SMEM).
 
     The grid's row dimension is the static worst case; steps past
     n_active revisit the last active block (index maps clamp), so the
     pipeline skips their DMA, and pl.when skips their matmuls — the cost
     of an inactive step is grid bookkeeping only.  This is what makes
-    sweep time proportional to the leaf's block range instead of N.
+    sweep time proportional to the leaf's block count instead of N.
     """
     r = pl.program_id(1)
     feat_block, blk = bins_ref.shape
@@ -197,6 +198,16 @@ def _hist_kernel_ranged(info_ref, bins_ref, gh_ref, leaf_ref, out_ref):
     @pl.when((r != 0) & active)
     def _acc():
         emit(False)
+
+
+def _hist_kernel_ranged(info_ref, bins_ref, gh_ref, leaf_ref, out_ref):
+    _hist_body(info_ref, bins_ref, gh_ref, leaf_ref, out_ref)
+
+
+def _hist_kernel_blocklist(info_ref, blist_ref, bins_ref, gh_ref, leaf_ref,
+                           out_ref):
+    # blist_ref is consumed by the index maps; the body only needs info
+    _hist_body(info_ref, bins_ref, gh_ref, leaf_ref, out_ref)
 
 
 @functools.partial(jax.jit,
@@ -252,6 +263,74 @@ def leaf_histogram_ranged(bins_t: jax.Array, gh2: jax.Array,
             (groups, fb // MM_FEATS, M_ROWS, N_COLS), jnp.float32),
         interpret=interpret,
     )(info, bins_t, gh2, leaf_eff)
+    part = out.reshape(-1, MM_FEATS, N_COMP, N_HI, MM_FEATS, N_LO)
+    diag = jnp.einsum("gfchfl->gfchl", part)
+    hist = diag.transpose(0, 1, 3, 4, 2).reshape(fpad, N_HI * N_LO, N_COMP)
+    return hist[:f, :max_bin, :]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bin", "grid_blocks", "row_block",
+                                    "interpret"))
+def leaf_histogram_blocklist(bins_t: jax.Array, gh2: jax.Array,
+                             leaf_eff: jax.Array, target_leaf,
+                             block_list: jax.Array, n_active, *,
+                             max_bin: int, grid_blocks: int = 0,
+                             row_block: int = PALLAS_ROW_BLOCK,
+                             interpret: bool = False) -> jax.Array:
+    """leaf_histogram_masked restricted to the row blocks named by
+    block_list[:n_active] (any order; ascending preserves the full
+    sweep's accumulation association, making the result BIT-identical to
+    it — skipped blocks contribute exact +0.0f).  Correct whenever every
+    row with leaf_eff == target_leaf lies in a listed block; rows of
+    other leaves in listed blocks are masked as usual.
+
+    grid_blocks statically bounds the grid (and therefore the per-call
+    floor cost); callers dispatch over a ladder of compiled variants and
+    pick the smallest with grid_blocks >= n_active.  Steps past n_active
+    revisit the last listed block (no DMA) and skip their matmuls.
+    """
+    f, n = bins_t.shape
+    assert n % row_block == 0, (n, row_block)
+    assert max_bin <= N_HI * N_LO, max_bin
+    fb = _feat_block(f)
+    fpad = ((f + fb - 1) // fb) * fb
+    if fpad != f:
+        bins_t = jnp.pad(bins_t, ((0, fpad - f), (0, 0)))
+    groups = fpad // fb
+    nblocks = n // row_block
+    if grid_blocks <= 0 or grid_blocks > nblocks:
+        grid_blocks = nblocks
+    info = jnp.stack([jnp.asarray(target_leaf, jnp.int32),
+                      jnp.int32(0),
+                      jnp.clip(jnp.asarray(n_active, jnp.int32), 1,
+                               grid_blocks)])
+    blist = jnp.clip(block_list.astype(jnp.int32), 0, nblocks - 1)
+
+    def _rb(r, info_ref, blist_ref):
+        return blist_ref[jnp.minimum(r, info_ref[2] - 1)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(groups, grid_blocks),
+        in_specs=[
+            pl.BlockSpec((fb, row_block),
+                         lambda i, r, s, bl: (i, _rb(r, s, bl))),
+            pl.BlockSpec((2, row_block),
+                         lambda i, r, s, bl: (0, _rb(r, s, bl))),
+            pl.BlockSpec((row_block,),
+                         lambda i, r, s, bl: (_rb(r, s, bl),)),
+        ],
+        out_specs=pl.BlockSpec((1, fb // MM_FEATS, M_ROWS, N_COLS),
+                               lambda i, r, s, bl: (i, 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _hist_kernel_blocklist,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (groups, fb // MM_FEATS, M_ROWS, N_COLS), jnp.float32),
+        interpret=interpret,
+    )(info, blist, bins_t, gh2, leaf_eff)
     part = out.reshape(-1, MM_FEATS, N_COMP, N_HI, MM_FEATS, N_LO)
     diag = jnp.einsum("gfchfl->gfchl", part)
     hist = diag.transpose(0, 1, 3, 4, 2).reshape(fpad, N_HI * N_LO, N_COMP)

@@ -199,6 +199,30 @@ class FeatureShardedGrower:
     def padded_features(self, f: int) -> int:
         return padded_size(f, self.num_shards)
 
+    def _put_feature_sharded(self, arr: np.ndarray) -> jax.Array:
+        """Place an array split on its FIRST (feature) axis.
+
+        Multi-host (the reference's multi-machine
+        FeatureParallelTreeLearner: every machine holds ALL rows and a
+        feature slice, feature_parallel_tree_learner.cpp:45-78): each
+        process passes the IDENTICAL full array (all machines loaded the
+        whole file) and contributes the slices its own devices own —
+        assembled with make_array_from_process_local_data without any
+        cross-host copy."""
+        spec = P(*([FEATURE_AXIS] + [None] * (arr.ndim - 1)))
+        sharding = NamedSharding(self.mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        chunk = arr.shape[0] // self.num_shards
+        pos = {d: i for i, d in enumerate(self.mesh.devices.flat)}
+        mine = sorted((d for d in self.mesh.devices.flat
+                       if d.process_index == jax.process_index()),
+                      key=lambda d: pos[d])
+        local = np.concatenate([arr[pos[d] * chunk:(pos[d] + 1) * chunk]
+                                for d in mine])
+        return jax.make_array_from_process_local_data(sharding, local,
+                                                      arr.shape)
+
     def shard_bins(self, bins: np.ndarray) -> jax.Array:
         """Pad F to a multiple of the shard count (padded features have
         all-zero bins and a False feature_mask) and place split on F."""
@@ -206,19 +230,33 @@ class FeatureShardedGrower:
         pad = self.padded_features(f) - f
         if pad:
             bins = np.pad(bins, ((0, pad), (0, 0)))
-        return jax.device_put(
-            bins, NamedSharding(self.mesh, P(FEATURE_AXIS, None)))
+        return self._put_feature_sharded(bins)
 
     def shard_rows(self, arr: np.ndarray, n_pad: int, fill=0) -> jax.Array:
-        """Rows are replicated under feature parallelism; pad and place."""
+        """Rows are replicated under feature parallelism; pad and place
+        (multi-host: every process passes the identical full array)."""
         return _pad_rows_and_put(arr, n_pad, fill, self.mesh,
                                  P(*([None] * arr.ndim)))
+
+    def replicate(self, arr) -> jax.Array:
+        return _put_sharded(np.asarray(arr), self.mesh, P())
+
+    def local_replicated(self, garr: jax.Array) -> jax.Array:
+        """Replicated global array -> process-local array."""
+        if jax.process_count() == 1:
+            return garr
+        return jnp.asarray(garr.addressable_data(0))
+
+    def replicated_to_local(self, tree):
+        if jax.process_count() == 1:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a.addressable_data(0)), tree)
 
     def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
         fmask = np.asarray(feature_mask)
         pad = self.padded_features(len(fmask)) - len(fmask)
         if pad:
             fmask = np.pad(fmask, (0, pad))
-        fmask = jax.device_put(
-            fmask, NamedSharding(self.mesh, P(FEATURE_AXIS)))
+        fmask = self._put_feature_sharded(fmask)
         return self._grow(bins_dev, grad, hess, bag_mask, fmask)

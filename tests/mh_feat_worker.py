@@ -1,0 +1,48 @@
+"""Worker for the 2-process FEATURE-parallel multi-host test
+(test_parallel.py::test_multihost_feature_parallel_two_process).
+
+Usage: python mh_feat_worker.py <rank> <nproc> <port> <data> <model_out>
+
+Each worker owns 4 virtual CPU devices (8 global), joins the jax
+distributed runtime, loads the WHOLE data file (the reference
+FeatureParallelTreeLearner's premise: all machines hold all rows,
+feature_parallel_tree_learner.cpp:45-78), and trains
+tree_learner=feature over the 8-way global feature mesh.
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4], sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "feature", "num_leaves": "8",
+    "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+    "hist_dtype": "float64", "metric": "", "is_save_binary_file": "false"})
+# every machine loads ALL rows (no rank sharding)
+ds = load_dataset(data, cfg, rank=0, num_shards=1)
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+booster = create_boosting(cfg, ds, obj)
+for _ in range(3):
+    booster.train_one_iter(None, None, False)
+booster.save_model_to_file(-1, True, out)
+print("worker %d done: %d trees" % (rank, len(booster.models)))

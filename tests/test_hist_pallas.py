@@ -94,3 +94,64 @@ def test_grow_tree_pallas_impl_matches_xla():
     np.testing.assert_array_equal(np.asarray(lp), np.asarray(lx))
     np.testing.assert_allclose(np.asarray(tp.leaf_value)[:nl],
                                np.asarray(tx.leaf_value)[:nl], rtol=1e-4)
+
+
+def test_blocklist_kernel_bit_identical_to_masked():
+    """Sweeping only the occupied blocks must be BIT-identical to the
+    full masked sweep: skipped blocks contribute exact +0.0f."""
+    from lightgbm_tpu.ops.hist_pallas import (leaf_histogram_blocklist,
+                                              leaf_histogram_masked,
+                                              make_gh2)
+    n = 8192 * 6
+    rng = np.random.RandomState(3)
+    bins = jnp.asarray(rng.randint(0, 255, size=(5, n)), dtype=jnp.uint8)
+    gh2 = make_gh2(jnp.asarray(rng.randn(n), jnp.float32),
+                   jnp.asarray(rng.rand(n), jnp.float32))
+    leaf = np.ones(n, np.int32)
+    for b in (1, 4):
+        s = 8192 * b
+        leaf[s:s + 8192] = np.where(rng.rand(8192) < 0.4, 3, 2)
+    leaf = jnp.asarray(leaf)
+    ref = leaf_histogram_masked(bins, gh2, leaf, jnp.int32(3),
+                                max_bin=255, interpret=True)
+    blist = jnp.asarray([1, 4, 0, 0, 0, 0], jnp.int32)
+    got = leaf_histogram_blocklist(bins, gh2, leaf, jnp.int32(3), blist,
+                                   jnp.int32(2), max_bin=255,
+                                   grid_blocks=4, interpret=True)
+    assert jnp.array_equal(ref, got)
+    # full list == full sweep; empty leaf (clamped n_active) == zeros
+    got2 = leaf_histogram_blocklist(bins, gh2, leaf, jnp.int32(3),
+                                    jnp.arange(6, dtype=jnp.int32),
+                                    jnp.int32(6), max_bin=255,
+                                    interpret=True)
+    assert jnp.array_equal(ref, got2)
+    z = leaf_histogram_blocklist(bins, gh2, leaf, jnp.int32(7), blist,
+                                 jnp.int32(0), max_bin=255,
+                                 grid_blocks=4, interpret=True)
+    assert float(jnp.abs(z).max()) == 0.0
+
+
+def test_grow_tree_ranged_bit_identical():
+    """ranged=True (block-list sweeps) must grow the IDENTICAL tree to
+    the plain pallas full sweep for the same row order."""
+    from lightgbm_tpu.ops.grow import grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    n = 8192 * 4
+    f, b = 6, 64
+    rng = np.random.RandomState(0)
+    bins_t = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    grad = (bins_t[0] / b - 0.5 + 0.2 * rng.randn(n)).astype(np.float32)
+    hess = np.ones(n, dtype=np.float32)
+    params = SplitParams(20, 1.0, 0.0, 0.0, 0.0)
+    bag = rng.rand(n) < 0.9   # bagging must also be exact
+    args = (jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(bag), jnp.ones(f, dtype=bool))
+    kw = dict(max_leaves=8, max_bin=b, params=params, hist_impl="pallas")
+    t0, l0 = grow_tree(*args, **kw)
+    t1, l1 = grow_tree(*args, ranged=True, **kw)
+    assert int(t0.num_leaves) == int(t1.num_leaves)
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    for fld in ("split_feature", "threshold_bin", "leaf_value",
+                "leaf_count"):
+        np.testing.assert_array_equal(np.asarray(getattr(t0, fld)),
+                                      np.asarray(getattr(t1, fld)))

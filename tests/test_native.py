@@ -338,3 +338,43 @@ def test_parse_bin_dense_mt_threads_equivalent(monkeypatch):
         native.parse_bin_dense_chunk(text, "\t", 6, col_map, spec,
                                      keep[:rows - 1], bins, rows, rows,
                                      label, None, None)
+
+
+@pytest.mark.slow
+def test_native_sanitizer_fuzz(tmp_path):
+    """ASan+UBSan pass over every text-facing native entry point with
+    mutated/malformed inputs (SURVEY.md §5 sanitizer CI; the harness is
+    native/fuzz_ingest.cpp).  Skips without a toolchain."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    here = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu", "native")
+    exe = str(tmp_path / "fuzz_ingest")
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         os.path.join(here, "fuzz_ingest.cpp"), "-o", exe, "-pthread"],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([exe, "2000"], capture_output=True, text=True,
+                         timeout=600)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "fuzz ok" in run.stdout
+
+
+def test_sort_importance_fallback_stable_sort():
+    """sort_importance reproduces libstdc++ introsort's tie permutation
+    ONLY when built against the same libstdc++ (documented dependency);
+    without native the caller's documented fallback is a stable
+    descending sort — pin that contract here."""
+    from lightgbm_tpu import native
+    counts = np.asarray([5, 3, 5, 1, 3, 5], dtype=np.uint64)
+    native_perm = native.sort_importance(counts)
+    if native_perm is not None:
+        # same keys descending regardless of tie order
+        assert list(counts[native_perm]) == sorted(counts, reverse=True)
+    # the no-native fallback path used by GBDT.feature_importance_footer:
+    pairs = sorted(enumerate(counts), key=lambda p: -int(p[1]))
+    assert [counts[i] for i, _ in pairs] == sorted(counts, reverse=True)

@@ -447,3 +447,69 @@ def test_matmul_predictor_matches_descent():
     np.testing.assert_array_equal(got, want)
     # the full predict path (while-loop descent on CPU) agrees too
     np.testing.assert_array_equal(b.predict_leaf_index(xt), want)
+
+
+def test_ordered_mode_end_to_end_matches_default():
+    """hist_ordered (ranged sweeps + periodic row re-sort) must produce
+    the same trees as the default full-sweep path; predictions agree to
+    f32 association noise."""
+    import lightgbm_tpu as lgb
+    n = 8192 * 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 6).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    common = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
+              "hist_impl": "pallas", "hist_dtype": "float32"}
+    b_off = lgb.train({**common, "hist_ordered": "off"},
+                      lgb.Dataset(x, label=y), num_boost_round=5,
+                      verbose_eval=False)
+    b_on = lgb.train({**common, "hist_ordered": "auto",
+                      "hist_reorder_every": 2},
+                     lgb.Dataset(x, label=y), num_boost_round=5,
+                     verbose_eval=False)
+    assert all(
+        np.array_equal(t1.split_feature_real, t2.split_feature_real)
+        and np.array_equal(t1.threshold_bin, t2.threshold_bin)
+        for t1, t2 in zip(b_off._gbdt.models, b_on._gbdt.models))
+    xt = rng.randn(300, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(b_off.predict(xt)),
+                               np.asarray(b_on.predict(xt)), atol=2e-5)
+
+
+def test_ordered_mode_custom_gradients_restore():
+    """Switching to custom (file-order) gradients after the ordered mode
+    re-sorted rows must restore file order first — trees must match a
+    run that never reordered."""
+    import lightgbm_tpu as lgb
+    n = 8192 * 2
+    rng = np.random.RandomState(1)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+
+    def fobj(scores, ds):
+        lab = 2.0 * np.asarray(ds.get_label()) - 1.0
+        r = -2.0 * lab / (1.0 + np.exp(2.0 * lab * np.asarray(scores)))
+        return r, np.abs(r) * (2.0 - np.abs(r))
+
+    common = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "",
+              "hist_impl": "pallas", "hist_dtype": "float32"}
+
+    models = []
+    for ordered in ("off", "auto"):
+        ds = lgb.Dataset(x, label=y)
+        bst = lgb.Booster({**common, "hist_ordered": ordered,
+                           "hist_reorder_every": 1}, ds)
+        for it in range(4):
+            if it < 2:
+                bst.update()           # fused path (may re-sort)
+            else:
+                bst.update(fobj=lambda preds, data: fobj(preds, ds))
+        models.append(bst._gbdt.models)
+    for t_off, t_on in zip(*models):
+        np.testing.assert_array_equal(t_off.split_feature_real,
+                                      t_on.split_feature_real)
+        np.testing.assert_array_equal(t_off.threshold_bin,
+                                      t_on.threshold_bin)
