@@ -153,7 +153,9 @@ class Application:
 
     def _set_init_scores(self, ds: Dataset, fname: str) -> None:
         with open(fname) as f:
-            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            # non-empty = any character, matching the native scanner and
+            # the loader's row counting (a whitespace-only line is a row)
+            lines = [ln for ln in f.read().splitlines() if ln]
         if self.config.has_header:
             lines = lines[1:]
         _, feats, _ = parse_file_lines(lines, ds.label_idx)
@@ -187,6 +189,14 @@ class Application:
         cfg = self.config
         if not cfg.input_model:
             log.fatal("Need a model file for prediction (input_model)")
+        import jax
+        if jax.default_backend() != "cpu":
+            # x64 lets the f64 score accumulation fuse into the device
+            # dispatch (ops/predict.accumulate_scores): the per-chunk
+            # readback shrinks from [C, T] leaf indices to [K, C]
+            # doubles, bit-identically.  Prediction-only process, so no
+            # training path sees the flag.
+            jax.config.update("jax_enable_x64", True)
         btype = boosting_type_from_model_file(cfg.input_model)
         cfg.boosting_type = btype
         self.boosting = GBDT(cfg, None, None)
@@ -226,7 +236,7 @@ class Application:
                 # _set_init_scores and io/dataset._skip_header
                 skip = cfg.has_header
                 for ln in f:
-                    if not ln.strip():
+                    if not ln:   # same non-empty rule as the loader
                         continue
                     if skip:
                         skip = False
@@ -268,16 +278,27 @@ class Application:
                 feats = feats[:, :n_total_feat]
             return feats
 
-        def format_rows(feats):
+        def format_block(feats) -> bytes:
             if cfg.is_predict_leaf_index:
                 out = booster.predict_leaf_index(feats)      # [N, T]
-                return ["\t".join(str(int(v)) for v in row) for row in out]
+                return ("\n".join(
+                    "\t".join(str(int(v)) for v in row) for row in out)
+                    + "\n").encode()
             if cfg.is_predict_raw_score:
                 res = booster.predict_raw(feats)             # [K, N]
             else:
                 res = booster.predict(feats)
-            return ["\t".join("%g" % v for v in res[:, i])
-                    for i in range(res.shape[1])]
+            # bulk native %g (byte-identical to Python's "%g" for finite
+            # doubles; Predictor::SaveTextPredictionsToFile role) — the
+            # per-value Python loop was a measured chunk of predict wall
+            from . import native
+            rows = np.ascontiguousarray(res.T)               # [N, K]
+            blob = native.format_g(rows)
+            if blob is not None:
+                return blob
+            return ("\n".join(
+                "\t".join("%g" % v for v in res[:, i])
+                for i in range(res.shape[1])) + "\n").encode()
 
         gen = blocks()
         # pull the first block BEFORE opening (truncating) the output file
@@ -285,16 +306,14 @@ class Application:
         first = next(gen, None)
         if first is None:
             log.fatal("Data file %s is empty" % cfg.data)
-        with open(cfg.output_result, "w") as out_f, \
+        with open(cfg.output_result, "wb") as out_f, \
                 ThreadPoolExecutor(max_workers=1) as ex:
             pending = ex.submit(parse, first)
             for lines in gen:
                 nxt = ex.submit(parse, lines)
-                for row in format_rows(pending.result()):
-                    out_f.write(row + "\n")
+                out_f.write(format_block(pending.result()))
                 pending = nxt
-            for row in format_rows(pending.result()):
-                out_f.write(row + "\n")
+            out_f.write(format_block(pending.result()))
         log.info("Finished prediction, results saved to %s"
                  % cfg.output_result)
 

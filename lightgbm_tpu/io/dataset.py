@@ -597,10 +597,20 @@ def load_dataset(filename: str, config: Config,
             # the reference format carries no label_idx or init scores:
             # label_idx is config-owned (like the reference, which reads
             # it from io_config on every load) and init scores reload
-            # from the sidecar (Metadata::LoadInitialScore)
-            ds.label_idx = max(
-                _parse_column_spec(config.label_column, ds.feature_names),
-                0)
+            # from the sidecar (Metadata::LoadInitialScore).  Names in
+            # the file are LABEL-FREE, so a name-based label spec cannot
+            # resolve against them — fall back to 0 with a warning
+            # rather than fatal (the binary data has no label column
+            # anyway; the index only feeds the model's label_index)
+            spec = config.label_column.strip()
+            if spec.startswith("name:") and spec[5:] not in ds.feature_names:
+                log.warning("label_column %s not resolvable from the "
+                            "binary cache's label-free names; using 0"
+                            % spec)
+            else:
+                ds.label_idx = max(
+                    _parse_column_spec(config.label_column,
+                                       ds.feature_names), 0)
             init = _load_sidecar(filename + ".init")
             if init is not None:
                 ds.metadata.init_score = init
@@ -816,11 +826,22 @@ def _save_binary(ds: Dataset, path: str, num_class: int = 1) -> None:
     def i32(v):
         return np.int32(v).tobytes()
 
+    # the format carries EXACTLY num_total_features names; headered
+    # files keep the label column's name in ds.feature_names, which must
+    # not shift the feature names (reference feature_names_ are
+    # label-free)
+    names = list(ds.feature_names)
+    if len(names) == ds.num_total_features + 1:
+        names = [nm for c, nm in enumerate(names) if c != ds.label_idx]
+    if len(names) < ds.num_total_features:
+        names += ["Column_%d" % i
+                  for i in range(len(names), ds.num_total_features)]
+    names = names[:ds.num_total_features]
     header = [i32(n), i32(num_class), i32(ds.num_features),
               i32(ds.num_total_features),
               u64(len(ds.used_feature_map)),
               np.asarray(ds.used_feature_map, dtype=np.int32).tobytes()]
-    for name in ds.feature_names:
+    for name in names:
         b = name.encode("utf-8")
         header += [i32(len(b)), b]
     header_blob = b"".join(header)
@@ -880,10 +901,14 @@ def _load_binary(path: str) -> Dataset:
     """Read the reference binary dataset format (the inverse of
     _save_binary; reference DatasetLoader::LoadFromBinFile,
     src/io/dataset_loader.cpp:247-406) — including files the reference
-    binary itself wrote, as long as every feature serialized dense."""
-    with open(path, "rb") as f:
-        blob = f.read()
-    r = _BinReader(blob)
+    binary itself wrote, as long as every feature serialized dense.
+
+    Streams feature payloads straight out of an np.memmap view into the
+    preallocated bins matrix: peak memory is the bins matrix + one
+    feature's transient, not 3x the file (the cache fast path must not
+    blow the budget the streaming loader guarantees)."""
+    mm_file = np.memmap(path, dtype=np.uint8, mode="r")
+    r = _BinReader(mm_file)
     hsize = int(r.take(np.uint64)[0])
     h = _BinReader(r.raw(hsize))
     n = int(h.take(np.int32)[0])
@@ -891,11 +916,11 @@ def _load_binary(path: str) -> Dataset:
     num_features = int(h.take(np.int32)[0])
     num_total = int(h.take(np.int32)[0])
     n_map = int(h.take(np.uint64)[0])
-    used_feature_map = h.take(np.int32, n_map).copy()
+    used_feature_map = np.array(h.take(np.int32, n_map))
     names = []
     for _ in range(num_total):
         ln = int(h.take(np.int32)[0])
-        names.append(h.raw(ln).decode("utf-8", "replace"))
+        names.append(bytes(h.raw(ln)).decode("utf-8", "replace"))
 
     msize = int(r.take(np.uint64)[0])
     m = _BinReader(r.raw(msize))
@@ -904,34 +929,36 @@ def _load_binary(path: str) -> Dataset:
         raise ValueError("metadata row count mismatch")
     n_w = int(m.take(np.int32)[0])
     n_q = int(m.take(np.int32)[0])
-    label = m.take(np.float32, n).copy()
-    weights = m.take(np.float32, n_w).copy() if n_w else None
-    qb = m.take(np.int32, n_q + 1).copy() if n_q else None
+    label = np.array(m.take(np.float32, n))
+    weights = np.array(m.take(np.float32, n_w)) if n_w else None
+    qb = np.array(m.take(np.int32, n_q + 1)) if n_q else None
 
+    # two passes over the feature sections: sizes/mappers first (cheap),
+    # then payloads directly into the right-dtype preallocated matrix
     mappers: List[BinMapper] = []
     real_index = []
-    rows = []
+    payload_at = []
     for _ in range(num_features):
         fsize = int(r.take(np.uint64)[0])
         fb = _BinReader(r.raw(fsize))
         real_index.append(int(fb.take(np.int32)[0]))
-        if fb.raw(1) != b"\x00":
+        if bytes(fb.raw(1)) != b"\x00":
             raise ValueError("sparse feature sections are not supported "
                              "(is_enable_sparse data)")
         num_bin = int(fb.take(np.int32)[0])
-        trivial = fb.raw(1) != b"\x00"
+        trivial = bytes(fb.raw(1)) != b"\x00"
         sparse_rate = float(fb.take(np.float64)[0])
-        bounds = fb.take(np.float64, num_bin).copy()
-        val_t = np.uint8 if num_bin <= 256 else np.uint16
-        rows.append(fb.take(val_t, n).copy())
+        bounds = np.array(fb.take(np.float64, num_bin), dtype=np.float64)
+        payload_at.append(fb)
         mappers.append(BinMapper(bin_upper_bound=bounds, num_bin=num_bin,
                                  is_trivial=trivial,
                                  sparse_rate=sparse_rate))
     dtype = (np.uint16 if any(m_.num_bin > 256 for m_ in mappers)
              else np.uint8)
     bins = np.zeros((num_features, n), dtype=dtype)
-    for i, row in enumerate(rows):
-        bins[i] = row
+    for i, fb in enumerate(payload_at):
+        val_t = np.uint8 if mappers[i].num_bin <= 256 else np.uint16
+        bins[i] = fb.take(val_t, n)       # memmap view -> one row copy
     metadata = Metadata(label=label, weights=weights,
                         query_boundaries=qb)
     metadata.finish_queries()

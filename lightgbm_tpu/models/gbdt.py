@@ -1082,42 +1082,52 @@ class GBDT:
         step = self.PREDICT_MM_CHUNK if use_mm else self.PREDICT_CHUNK
         n = x.shape[0]
         out = np.empty((n, nmodels), dtype=np.int64)
-        # dispatch chunks asynchronously with a BOUNDED in-flight window:
-        # the device pipelines chunk k+1 while chunk k's result reads
-        # back (the remote-tunnel round trip amortizes), but device
-        # buffers stay O(window), not O(N)
+
+        def per_chunk(chunk):
+            xh, xl = split_hi_lo(chunk)
+            if use_mm:
+                tables, mm_dev = mm
+                code = rank_encode(xh, xl, tables)
+                return predict_leaf_matmul(
+                    *mm_dev, jnp.asarray(code),
+                    tree_block=self.PREDICT_TREE_BLOCK)
+            return predict_leaf_stacked(*dev, jnp.asarray(xh),
+                                        jnp.asarray(xl))
+
+        def write(a, rows, got):
+            got = got[:rows]
+            out[a:a + rows] = got[:, :nmodels] if use_mm else got
+
+        self._predict_pipeline(x, step, per_chunk, write)
+        return out
+
+    def _predict_pipeline(self, x, step, per_chunk, write) -> None:
+        """Bounded-in-flight chunk dispatch shared by the predict paths:
+        the device pipelines chunk k+1 while chunk k's result reads back
+        (the remote-tunnel round trip amortizes), but device buffers stay
+        O(window), not O(N).  Rows pad up to a power-of-two bucket: one
+        compiled executable per bucket instead of per distinct batch
+        size.  per_chunk(padded_chunk) -> device array; write(a, rows,
+        host_array) consumes results in order."""
         pending = []
 
         def drain(limit):
             while len(pending) > limit:
-                a, rows, leaves = pending.pop(0)
-                got = np.asarray(leaves)[:rows]
-                out[a:a + rows] = got[:, :nmodels] if use_mm else got
+                a, rows, dev_res = pending.pop(0)
+                write(a, rows, np.asarray(dev_res))
 
+        n = x.shape[0]
         for a in range(0, n, step):
             chunk = np.ascontiguousarray(x[a:a + step])
-            # pad rows up to a power-of-two bucket: one compiled traversal
-            # per bucket instead of one per distinct batch size
             rows = chunk.shape[0]
             bucket = 256
             while bucket < rows:
                 bucket <<= 1
             if bucket > rows:
                 chunk = np.pad(chunk, ((0, bucket - rows), (0, 0)))
-            xh, xl = split_hi_lo(chunk)
-            if use_mm:
-                tables, mm_dev = mm
-                code = rank_encode(xh, xl, tables)
-                leaves = predict_leaf_matmul(
-                    *mm_dev, jnp.asarray(code),
-                    tree_block=self.PREDICT_TREE_BLOCK)
-            else:
-                leaves = predict_leaf_stacked(*dev, jnp.asarray(xh),
-                                              jnp.asarray(xl))
-            pending.append((a, rows, leaves))
+            pending.append((a, rows, per_chunk(chunk)))
             drain(self.PREDICT_INFLIGHT)
         drain(0)
-        return out
 
     def predict_raw(self, x: np.ndarray) -> np.ndarray:
         """x [N, num_total_features] -> [K, N] raw scores."""
@@ -1126,6 +1136,14 @@ class GBDT:
         nmodels = self.num_used_model * k
         if nmodels == 0 or n == 0:
             return np.zeros((k, n), dtype=np.float64)
+        if jax.default_backend() != "cpu" and jax.config.jax_enable_x64:
+            # fuse the f64 accumulation into the device dispatch: the
+            # [C, T] leaf-index readback (the remote-tunnel predict
+            # bottleneck) collapses to [K, C] doubles, bit-identically
+            # (ops/predict.accumulate_scores replays the host loop)
+            out = self._predict_raw_device(x, nmodels)
+            if out is not None:
+                return out
         leaves = self._predict_leaves(x, nmodels)
         lv = self._stacked_trees(nmodels)["lv"]
         out = np.zeros((k, n), dtype=np.float64)
@@ -1133,6 +1151,49 @@ class GBDT:
         # reference predictor's += tree->Predict (predictor.hpp:35-70)
         for i in range(nmodels):
             out[i % k] += lv[i, leaves[:, i]]
+        return out
+
+    def _predict_raw_device(self, x: np.ndarray,
+                            nmodels: int) -> "Optional[np.ndarray]":
+        """Chunked matmul-predictor leaves + on-device f64 accumulation;
+        None when the matmul pack declines (wide features / code
+        overflow), falling back to the leaf-readback path."""
+        from ..ops.predict import (accumulate_scores, predict_leaf_matmul,
+                                   rank_encode, split_hi_lo)
+        x = np.asarray(x, dtype=np.float64)
+        want = self.max_feature_idx + 1
+        if x.shape[1] < want:
+            x = np.pad(x, ((0, 0), (0, want - x.shape[1])))
+        elif x.shape[1] > want:
+            x = x[:, :want]
+        pack = self._stacked_trees(nmodels)
+        mm = self._matmul_cached(pack)
+        if mm is None:
+            return None
+        if "lv_dev" not in pack or pack["lv_dev"] is None:
+            pack["lv_dev"] = jnp.asarray(pack["lv"], dtype=jnp.float64)
+        lv_dev = pack["lv_dev"]
+        if lv_dev.dtype != jnp.float64:   # x64 actually off: not exact
+            pack["lv_dev"] = None
+            return None
+        k = self.num_class
+        n = x.shape[0]
+        out = np.zeros((k, n), dtype=np.float64)
+        tables, mm_dev = mm
+
+        def per_chunk(chunk):
+            xh, xl = split_hi_lo(chunk)
+            code = rank_encode(xh, xl, tables)
+            leaves = predict_leaf_matmul(
+                *mm_dev, jnp.asarray(code),
+                tree_block=self.PREDICT_TREE_BLOCK)
+            return accumulate_scores(leaves[:, :nmodels], lv_dev,
+                                     num_class=k)
+
+        def write(a, rows, scores):
+            out[:, a:a + rows] = scores[:, :rows]
+
+        self._predict_pipeline(x, self.PREDICT_MM_CHUNK, per_chunk, write)
         return out
 
     def predict(self, x: np.ndarray) -> np.ndarray:

@@ -130,6 +130,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_parse_dense_mt.restype = i64
     lib.lgt_selection_mask.argtypes = [pd, i64, i64, pu8]
     lib.lgt_selection_mask.restype = None
+    lib.lgt_format_g.argtypes = [pd, i64, i64, ctypes.c_char_p]
+    lib.lgt_format_g.restype = i64
     _lib = lib
     return _lib
 
@@ -406,6 +408,20 @@ def scan_libsvm(text: bytes) -> Optional[Tuple[int, int]]:
     lib.lgt_scan_libsvm(text, len(text), ctypes.byref(rows),
                         ctypes.byref(max_idx))
     return rows.value, max_idx.value
+
+
+def format_g(vals: np.ndarray) -> Optional[bytes]:
+    """[nrows, ncols] f64 -> the bytes of '\\t'-joined %g rows with
+    trailing newlines (identical to Python's '%g' for finite doubles);
+    None without native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    nrows, ncols = vals.shape
+    buf = ctypes.create_string_buffer(int(nrows * ncols * 26 + 1))
+    got = lib.lgt_format_g(_dbl_ptr(vals), nrows, ncols, buf)
+    return ctypes.string_at(buf, got)
 
 
 def selection_mask(draws: np.ndarray, k: int) -> Optional[np.ndarray]:

@@ -21,6 +21,7 @@
 #include <cctype>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <limits>
@@ -935,6 +936,26 @@ void lgt_selection_mask(const double* draws, int64_t n, int64_t k,
       mask[i] = 0;
     }
   }
+}
+
+// Bulk "%g" score formatting for task=predict output
+// (Predictor::SaveTextPredictionsToFile equivalent): vals is [nrows,
+// ncols] row-major; each row prints ncols "%g" fields joined by '\t'
+// with a trailing '\n' — exactly what Python's "%g" % v produces for
+// finite doubles, just without a million PyObject round-trips.
+// out must hold >= nrows * ncols * 26 bytes; returns bytes written.
+int64_t lgt_format_g(const double* vals, int64_t nrows, int64_t ncols,
+                     char* out) {
+  char* p = out;
+  for (int64_t r = 0; r < nrows; ++r) {
+    const double* row = vals + r * ncols;
+    for (int64_t c = 0; c < ncols; ++c) {
+      if (c) *p++ = '\t';
+      p += snprintf(p, 26, "%g", row[c]);
+    }
+    *p++ = '\n';
+  }
+  return p - out;
 }
 
 void lgt_sort_importance(const uint64_t* counts, int64_t n, int32_t* perm) {

@@ -216,6 +216,37 @@ def predict_leaf_matmul(sel: jax.Array, thr_code: jax.Array,
     return leaves.reshape(t_total, c).T
 
 
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def accumulate_scores(leaves: jax.Array, leaf_values: jax.Array,
+                      *, num_class: int) -> jax.Array:
+    """On-device f64 score accumulation in boosting order.
+
+    EXACTLY the host loop of GBDT.predict_raw (`out[i % k] +=
+    leaf_values[i, leaves[:, i]]` for i ascending — the reference
+    predictor's += tree->Predict, predictor.hpp:35-70): a lax.scan over
+    trees performs the same sequence of f64 additions per row, so the
+    result is bit-identical to the host path while the device->host
+    transfer shrinks from [C, T] leaf indices to [K, C] doubles — the
+    remote-tunnel predict bottleneck.  Requires x64 (the CLI predict
+    path enables it on accelerators).
+
+    leaves [C, T] int; leaf_values [T, L] f64.  Returns [K, C] f64.
+    """
+    c = leaves.shape[0]
+    t = leaf_values.shape[0]
+    out = jnp.zeros((num_class, c), dtype=jnp.float64)
+
+    def step(s, inp):
+        i, lv_t, leaf_t = inp
+        return s.at[i % num_class].add(lv_t[leaf_t]), None
+
+    out, _ = jax.lax.scan(
+        step, out,
+        (jnp.arange(t, dtype=jnp.int32), leaf_values,
+         leaves.T.astype(jnp.int32)))
+    return out
+
+
 @jax.jit
 def predict_leaf_stacked(split_feature_real: jax.Array, thr_hi: jax.Array,
                          thr_lo: jax.Array, left_child: jax.Array,
