@@ -37,11 +37,11 @@ def test_two_round_rss_bounded_vs_one_round():
     added_two = two["max_rss_mb"] - two["import_rss_mb"]
     added_one = one["max_rss_mb"] - one["import_rss_mb"]
     # structural bound: bins (~20 MB) + label (~3 MB) + chunk (8 MB) +
-    # reservoir/parse transients; 150 MB leaves ~4x headroom while still
-    # excluding any whole-file materialization (>= 150 MB of raw bytes
-    # alone on the one-round path)
-    assert added_two < 150, (one, two)
-    # weak relative sanity (not load-sensitive at this gap): one-round
-    # materializes raw bytes + an f64 matrix, several hundred MB
-    assert added_one > 150, (one, two)
+    # reservoir/parse transients measured ~115 MB added; 200 MB allows
+    # for allocator-arena variance under full-suite load while still
+    # excluding any whole-file materialization (raw bytes + an f64
+    # matrix is ~470 MB on the one-round path)
+    assert added_two < 200, (one, two)
+    # weak relative sanity (not load-sensitive at this gap)
+    assert added_one > 250, (one, two)
     assert added_two < added_one, (one, two)
