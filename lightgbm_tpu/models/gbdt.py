@@ -96,6 +96,12 @@ def _unpack_bag(bag_mask, n_pad):
     return bag_mask
 
 
+@jax.jit
+def _permute_packed_bag(packed: jax.Array, row_order: jax.Array):
+    """File-order packed bag bits -> ordered-space bool mask."""
+    return jnp.take(_unpack_bag(packed, row_order.shape[0]), row_order)
+
+
 def _make_fused_step(grad_fn, grow_kw, lr, dtype):
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
              gstate, stopped):
@@ -333,8 +339,10 @@ class GBDT:
         # sweeps are always on (bit-identical to full sweeps for a fixed
         # row order — empty blocks contribute exact zeros); the row
         # re-sort that makes them leaf-proportional additionally needs
-        # the fused path, a permutable objective, and no bagging (the
-        # in/out-of-bag draw is pinned to ORIGINAL row order)
+        # the fused path and a permutable objective.  Bagging composes:
+        # the in/out-of-bag draw stays pinned to FILE order (mt19937
+        # parity) and the mask permutes on device per re-bagging
+        # (_bag_mask_dev_fused).
         self.hist_ranged = (config.hist_ordered != "off"
                             and impl == "pallas" and self.grower is None)
         if config.hist_compact == "on" and self.hist_ranged:
@@ -489,7 +497,7 @@ class GBDT:
             self._bagging(self.iter, 0)
             fmask = self._feature_mask(0)
             self._models.append(self._run_fused(
-                self._bag_mask_dev_packed(0), jnp.asarray(fmask)))
+                self._bag_mask_dev_fused(0), jnp.asarray(fmask)))
         else:
             # leaving the fused path (custom gradients / objective swap):
             # gradients arrive in FILE order, so per-row state must be
@@ -567,9 +575,24 @@ class GBDT:
                 and self.objective.fused_key() is not None)
 
     def _reorder_enabled(self) -> bool:
-        return (self.hist_ranged and not self.bagging_enabled
+        # bagging composes with the ordered partition since round 3:
+        # masks draw on the host in FILE order (mt19937 parity) and are
+        # permuted once per re-bagging on device (_bag_mask_dev_fused)
+        return (self.hist_ranged
                 and getattr(self.objective, "row_permutable", False)
                 and self._can_fuse())
+
+    def _bag_mask_dev_fused(self, cls: int):
+        """Fused-path bag mask: bit-packed file-order upload normally;
+        under an active row order, the cached ORDERED bool mask —
+        rebuilt (unpack + one device take) only when re-bagging
+        invalidated it.  The reorder step keeps this cache permuted."""
+        if self._row_order is None:
+            return self._bag_mask_dev_packed(cls)
+        if self._bag_dev_packed[cls] is None:
+            self._bag_dev_packed[cls] = _permute_packed_bag(
+                self._bag_mask_dev_packed(cls), self._row_order)
+        return self._bag_dev_packed[cls]
 
     def _run_fused(self, bag_mask_dev, fmask_dev) -> "_PendingTree":
         cfg = self.config

@@ -513,3 +513,32 @@ def test_ordered_mode_custom_gradients_restore():
                                       t_on.split_feature_real)
         np.testing.assert_array_equal(t_off.threshold_bin,
                                       t_on.threshold_bin)
+
+
+def test_ordered_mode_bagged_matches_default():
+    """Ordered-partition mode with BAGGING + feature_fraction (round-3
+    extension: file-order mt19937 masks permuted on device) must grow
+    the same trees as the full-sweep path."""
+    import lightgbm_tpu as lgb
+    n = 8192 * 2
+    rng = np.random.RandomState(4)
+    x = rng.randn(n, 6).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    common = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
+              "hist_impl": "pallas", "hist_dtype": "float32",
+              "bagging_fraction": 0.8, "bagging_freq": 2,
+              "feature_fraction": 0.8}
+    b_off = lgb.train({**common, "hist_ordered": "off"},
+                      lgb.Dataset(x, label=y), num_boost_round=6,
+                      verbose_eval=False)
+    b_on = lgb.train({**common, "hist_ordered": "auto",
+                      "hist_reorder_every": 2},
+                     lgb.Dataset(x, label=y), num_boost_round=6,
+                     verbose_eval=False)
+    for t1, t2 in zip(b_off._gbdt.models, b_on._gbdt.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
