@@ -528,7 +528,10 @@ def test_ordered_mode_bagged_matches_default():
     common = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
               "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
               "hist_impl": "pallas", "hist_dtype": "float32",
-              "bagging_fraction": 0.8, "bagging_freq": 2,
+              # coprime freq/reorder cadence: re-bags must also land on
+              # STEADY (non-reorder) iterations so the rebuilt permuted
+              # mask feeds both executables
+              "bagging_fraction": 0.8, "bagging_freq": 3,
               "feature_fraction": 0.8}
     b_off = lgb.train({**common, "hist_ordered": "off"},
                       lgb.Dataset(x, label=y), num_boost_round=6,
