@@ -14,7 +14,10 @@ SCRIPT = os.path.join(REPO, "scripts", "ingest_bench.py")
 
 
 def _run(mode_args):
-    env = dict(os.environ)
+    # strip the suite's 8-virtual-device XLA_FLAGS: inherited by the
+    # subprocess it balloons the import-RSS baseline past 1 GB, zeroing
+    # both sides' "added" memory and voiding the structural assertions
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
         [sys.executable, SCRIPT, "--mb", "150", *mode_args],
