@@ -305,7 +305,7 @@ def run_predict_e2e(model_path):
     format over the SAME 1M-row TSV (VERDICT r2 #6; reference
     predictor.hpp:82-130)."""
     exe = ensure_ref_binary()
-    train_file = os.path.join(CACHE, "bench.train")
+    train_file = os.path.join(CACHE, "bench_%d.train" % N_ROWS)
     if not os.path.exists(train_file):
         x, y = make_data()
         np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
@@ -366,7 +366,7 @@ def _run_reference_binary(extra_args, key, field):
 
     exe = ensure_ref_binary()
     os.makedirs(CACHE, exist_ok=True)
-    train_file = os.path.join(CACHE, "bench.train")
+    train_file = os.path.join(CACHE, "bench_%d.train" % N_ROWS)
     if not os.path.exists(train_file):
         x, y = make_data()
         np.savetxt(train_file, np.concatenate([y[:, None], x], axis=1),
