@@ -12,15 +12,46 @@ Public surface:
   - Python API: Dataset/Booster (api.py) mirroring the reference C API's
     operations (dataset from file/array, booster create/update/eval/
     predict/save).
+
+Exports resolve lazily (PEP 562): importing the package does NOT import
+jax, so the native `task=predict` fast path (predict_fast.py) runs with
+the reference binary's process-startup profile.  The persistent XLA
+compilation cache that used to be enabled here is now enabled by the
+modules that actually trace jits (ops/*, objectives) before their first
+compile.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
-from .utils.compile_cache import enable_compilation_cache
-enable_compilation_cache()
+_EXPORTS = {
+    "Config": ".config",
+    "load_dataset": ".io.dataset",
+    "GBDT": ".models.gbdt",
+    "DART": ".models.gbdt",
+    "Tree": ".models.tree",
+    "Dataset": ".api",
+    "Booster": ".api",
+    "train": ".api",
+}
 
-from .config import Config                      # noqa: F401
-from .io.dataset import load_dataset            # noqa: F401
-from .models.gbdt import GBDT, DART             # noqa: F401
-from .models.tree import Tree                   # noqa: F401
-from .api import Dataset, Booster, train        # noqa: F401
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+    if name in _EXPORTS:
+        mod = importlib.import_module(_EXPORTS[name], __name__)
+        return getattr(mod, name)
+    # `lightgbm_tpu.native`-style submodule access without an explicit
+    # `import lightgbm_tpu.native`
+    try:
+        return importlib.import_module("." + name, __name__)
+    except ModuleNotFoundError as e:
+        if e.name != "%s.%s" % (__name__, name):
+            raise  # the submodule EXISTS but a dependency of it is missing
+        raise AttributeError(
+            "module %r has no attribute %r" % (__name__, name)) from None
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -9,27 +9,35 @@ unchanged.  The Network::Init socket bootstrap is replaced by the JAX mesh
 
 from __future__ import annotations
 
+import os
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, TYPE_CHECKING
 
 import numpy as np
 
 from . import config as config_mod
 from .config import Config
-from .io.dataset import Dataset, load_dataset
-from .metrics import create_metrics, Metric
-from .models.gbdt import (GBDT, NO_LIMIT, boosting_type_from_model_file,
-                          create_boosting)
-from .objectives import create_objective
-from .io.parser import parse_file_lines
 from .utils import log
+
+if TYPE_CHECKING:  # annotation-only names; runtime imports stay lazy
+    from .io.dataset import Dataset
+    from .metrics import Metric
+    from .models.gbdt import GBDT
+
+# Heavy modules (io.dataset, models.gbdt, metrics, objectives — all of
+# which pull in jax) import lazily inside the train / fallback-predict
+# paths: task=predict normally runs entirely through the native
+# predict_fast module, where the JAX import+backend cost would be a
+# multi-second tax the reference binary doesn't pay.
 
 
 class Application:
     def __init__(self, argv: List[str]):
         params = config_mod.load_parameters(argv)
         self.config = Config.from_params(params)
+
+    def _apply_device_type(self) -> None:
         if self.config.device_type == "cpu":
             # must run before any JAX backend initializes; overrides the
             # platform even when the environment pins JAX_PLATFORMS
@@ -40,9 +48,15 @@ class Application:
 
     def run(self) -> None:
         if self.config.task == "train":
+            self._apply_device_type()
             self.init_train()
             self.train()
         else:
+            if not os.environ.get("LGBM_TPU_NO_FAST_PREDICT"):
+                from .predict_fast import try_fast_predict
+                if try_fast_predict(self.config):
+                    return
+            self._apply_device_type()
             self.init_predict()
             self.predict()
 
@@ -60,6 +74,11 @@ class Application:
         # min and a config fingerprint check rejects inconsistent
         # per-rank hyper-parameters (GlobalSyncUpByMin,
         # application.cpp:119,188-193,255-282).
+        from .io.dataset import load_dataset
+        from .metrics import create_metrics
+        from .models.gbdt import GBDT, create_boosting
+        from .objectives import create_objective
+
         self.rank, self.num_machines = 0, 1
         if cfg.num_machines > 1:
             from .parallel.dist import (check_config_fingerprint,
@@ -151,7 +170,9 @@ class Application:
             self.boosting.stop_sync = stop_sync
         log.info("Finished initializing training")
 
-    def _set_init_scores(self, ds: Dataset, fname: str) -> None:
+    def _set_init_scores(self, ds, fname: str) -> None:
+        from .io.parser import parse_file_lines
+
         with open(fname) as f:
             # non-empty = any character, matching the native scanner and
             # the loader's row counting (a whitespace-only line is a row)
@@ -169,6 +190,8 @@ class Application:
         ds.metadata.init_score = raw.reshape(-1).astype(np.float64)
 
     def train(self) -> None:
+        from .models.gbdt import NO_LIMIT
+
         cfg = self.config
         log.info("Started training...")
         start = time.time()
@@ -186,6 +209,9 @@ class Application:
 
     # ------------------------------------------------------------------
     def init_predict(self) -> None:
+        from .models.gbdt import (GBDT, NO_LIMIT,
+                                  boosting_type_from_model_file)
+
         cfg = self.config
         if not cfg.input_model:
             log.fatal("Need a model file for prediction (input_model)")
@@ -222,6 +248,8 @@ class Application:
         whole-file path (goldens in test_e2e_parity pin all three modes).
         """
         from concurrent.futures import ThreadPoolExecutor
+
+        from .io.parser import parse_file_lines
 
         cfg = self.config
         log.info("Started prediction...")

@@ -134,7 +134,17 @@ def parse_dense(lines: List[str], sep: str, label_idx: int
         data[i] = vals
     label = data[:, label_idx].copy()
     feats = np.delete(data, label_idx, axis=1)
-    return label, feats
+    return label, _drop_tiny(feats)
+
+
+def _drop_tiny(feats: np.ndarray) -> np.ndarray:
+    """The dense parsers' |v| <= 1e-10 feature cutoff (reference
+    parser.hpp:32,62: values that small are never emitted, leaving the
+    bin at its value-0 default).  Parser-level semantics only: labels,
+    libsvm idx:val pairs, model-file doubles and C-API matrices all keep
+    tiny values, exactly like the reference."""
+    feats[np.abs(feats) <= 1e-10] = 0.0
+    return feats
 
 
 def parse_libsvm(lines: List[str], label_idx: int
@@ -181,7 +191,7 @@ def _native_parse(lines: List[str], label_idx: int, fmt: str):
             return None
         label = data[:, label_idx].copy()
         feats = np.delete(data, label_idx, axis=1)
-        return label, feats
+        return label, _drop_tiny(feats)
     out = native.parse_libsvm(text)
     if out is None or len(out[0]) != len(lines):
         return None
@@ -232,7 +242,7 @@ def parse_file_bytes(raw: bytes, label_idx: int,
             if data is not None and data.size:
                 label = data[:, label_idx].copy()
                 feats = np.delete(data, label_idx, axis=1)
-                return label, feats, fmt
+                return label, _drop_tiny(feats), fmt
         else:
             out = native.parse_libsvm(raw)
             if out is not None:

@@ -1431,47 +1431,18 @@ class GBDT:
         return out
 
     def load_model_from_string(self, model_str: str) -> None:
-        """GBDT::LoadModelFromString (gbdt.cpp:402-456)."""
-        lines = model_str.splitlines()
+        """GBDT::LoadModelFromString (gbdt.cpp:402-456).  Header + tree
+        parsing is shared with the native predict fast path via
+        models.tree.parse_model_text."""
+        from .tree import parse_model_text
 
-        def find_line(prefix):
-            for ln in lines:
-                if prefix in ln:
-                    return ln
-            return ""
-
-        ln = find_line("num_class=")
-        if not ln:
-            log.fatal("Model file doesn't specify the number of classes")
-        self.num_class = int(ln.split("=")[1])
-        ln = find_line("label_index=")
-        if not ln:
-            log.fatal("Model file doesn't specify the label index")
-        self.label_idx = int(ln.split("=")[1])
-        ln = find_line("max_feature_idx=")
-        if not ln:
-            log.fatal("Model file doesn't specify max_feature_idx")
-        self.max_feature_idx = int(ln.split("=")[1])
-        ln = find_line("sigmoid=")
-        if ln:
-            # Atof semantics, like every double the reference reads back
-            from ..io.parser import _clean_token
-            self.sigmoid = _clean_token(ln.split("=")[1])
-
-        self.models = []
-        i = 0
-        while i < len(lines):
-            if lines[i].startswith("Tree="):
-                j = i + 1
-                while j < len(lines) and not lines[j].startswith("Tree="):
-                    j += 1
-                block = "\n".join(lines[i + 1:j])
-                if "num_leaves=" in block:
-                    self.models.append(Tree.from_string(block))
-                i = j
-            else:
-                i += 1
-        log.info("Finished loading %d models" % len(self.models))
+        header, trees = parse_model_text(model_str)
+        self.num_class = header["num_class"]
+        self.label_idx = header["label_index"]
+        self.max_feature_idx = header["max_feature_idx"]
+        if header["sigmoid"] is not None:
+            self.sigmoid = header["sigmoid"]
+        self.models = trees
         self.num_used_model = len(self.models) // self.num_class
 
 

@@ -145,3 +145,59 @@ class Tree:
             node[active] = nxt
             active = node >= 0
         return ~node
+
+
+def parse_model_text(model_str: str):
+    """Model text -> (header dict, [Tree]) — the jax-free core of
+    GBDT::LoadModelFromString (reference gbdt.cpp:402-456), shared by
+    GBDT.load_model_from_string and the native predict fast path
+    (predict_fast._LightModel) so the two readers cannot drift.
+
+    Header keys: num_class, label_index, max_feature_idx (ints, fatal if
+    absent like the reference) and sigmoid (Atof-parsed; None when the
+    line is absent, so callers can keep their configured value exactly
+    like the original in-place parse did)."""
+    from ..utils import log
+
+    lines = model_str.splitlines()
+
+    def find_line(prefix: str) -> str:
+        for ln in lines:
+            if prefix in ln:
+                return ln
+        return ""
+
+    header = {}
+    ln = find_line("num_class=")
+    if not ln:
+        log.fatal("Model file doesn't specify the number of classes")
+    header["num_class"] = int(ln.split("=")[1])
+    ln = find_line("label_index=")
+    if not ln:
+        log.fatal("Model file doesn't specify the label index")
+    header["label_index"] = int(ln.split("=")[1])
+    ln = find_line("max_feature_idx=")
+    if not ln:
+        log.fatal("Model file doesn't specify max_feature_idx")
+    header["max_feature_idx"] = int(ln.split("=")[1])
+    header["sigmoid"] = None
+    ln = find_line("sigmoid=")
+    if ln:
+        # Atof semantics, like every double the reference reads back
+        header["sigmoid"] = _clean_token(ln.split("=")[1])
+
+    trees: List[Tree] = []
+    i = 0
+    while i < len(lines):
+        if lines[i].startswith("Tree="):
+            j = i + 1
+            while j < len(lines) and not lines[j].startswith("Tree="):
+                j += 1
+            block = "\n".join(lines[i + 1:j])
+            if "num_leaves=" in block:
+                trees.append(Tree.from_string(block))
+            i = j
+        else:
+            i += 1
+    log.info("Finished loading %d models" % len(trees))
+    return header, trees

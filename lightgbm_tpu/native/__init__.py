@@ -132,6 +132,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgt_selection_mask.restype = None
     lib.lgt_format_g.argtypes = [pd, i64, i64, ctypes.c_char_p]
     lib.lgt_format_g.restype = i64
+    lib.lgt_predict_dense_mt.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_char, i64, i64, pi32, pd, pi32,
+        pi32, pd, pi64, pi64, i64, i64, ctypes.c_double, i32,
+        ctypes.c_char_p, i64, i32, pi64]
+    lib.lgt_predict_dense_mt.restype = i64
+    lib.lgt_predict_libsvm_mt.argtypes = [
+        ctypes.c_char_p, i64, i64, pi32, pd, pi32, pi32, pd, pi64, pi64,
+        i64, i64, ctypes.c_double, i32, ctypes.c_char_p, i64, i32, pi64]
+    lib.lgt_predict_libsvm_mt.restype = i64
     _lib = lib
     return _lib
 
@@ -467,3 +476,92 @@ def bin_values(vals: np.ndarray, bounds: np.ndarray
                        np.int32(len(bounds)),
                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out
+
+
+class ForestSpec:
+    """Flattened forest for the native predict kernels (the warm-process
+    Predictor fast path, reference predictor.hpp:82-130): per-model inner
+    node arrays at node_off[m], leaf values at leaf_off[m].  Models are
+    the USED models in reference order i*num_class+j."""
+
+    def __init__(self, trees, num_class: int, sigmoid: float):
+        self.num_class = int(num_class)
+        self.sigmoid = float(sigmoid)
+        self.num_models = len(trees)
+        nl = [t.num_leaves for t in trees]
+        self.node_off = np.zeros(len(trees) + 1, dtype=np.int64)
+        np.cumsum([max(n - 1, 0) for n in nl], out=self.node_off[1:])
+        self.leaf_off = np.zeros(len(trees) + 1, dtype=np.int64)
+        np.cumsum(nl, out=self.leaf_off[1:])
+
+        def cat(key, dtype):
+            arrs = [np.asarray(getattr(t, key), dtype=dtype) for t in trees]
+            return (np.ascontiguousarray(np.concatenate(arrs))
+                    if arrs else np.zeros(0, dtype=dtype))
+
+        self.sf = cat("split_feature_real", np.int32)
+        self.thr = cat("threshold", np.float64)
+        self.lc = cat("left_child", np.int32)
+        self.rc = cat("right_child", np.int32)
+        self.lv = cat("leaf_value", np.float64)
+
+
+def predict_chunk(text: bytes, fmt: str, sep: str, label_idx: int,
+                  num_feat: int, forest: "ForestSpec", mode: int,
+                  nthreads: int = 0, row0: int = 0
+                  ) -> Optional[Tuple[bytes, int]]:
+    """One fused parse->descend->transform->format pass over a chunk of
+    prediction input (lines only, header already stripped).  mode: 0
+    transformed score, 1 raw score, 2 leaf index.  row0 is the data-row
+    index of the chunk's first line so parse errors report FILE rows, not
+    chunk-relative ones.  Returns (formatted output bytes, rows in this
+    chunk), or None when native is unavailable.  Raises via log.fatal on
+    malformed tokens like every other native parse path."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if mode == 2:
+        per_row = forest.num_models * 13 + 2
+    else:
+        per_row = forest.num_class * 27 + 2
+    # output sizing without a dedicated line-count pass (the kernel's own
+    # plan already counts rows): estimate rows from the first line's
+    # length, and if the guess undershoots (ragged line lengths) retry
+    # once with the exact count the kernel reported
+    first_nl = text.find(b"\n")
+    line_len = (first_nl + 1) if first_nl >= 0 else max(len(text), 1)
+    rows_est = len(text) // max(line_len, 1) + 8
+    cap = int(rows_est * per_row * 9 // 8 + 16)
+    seen = ctypes.c_int64()
+    pi = ctypes.POINTER(ctypes.c_int64)
+
+    def run(cap):
+        buf = ctypes.create_string_buffer(cap)
+        common = (_i32_ptr(forest.sf), _dbl_ptr(forest.thr),
+                  _i32_ptr(forest.lc), _i32_ptr(forest.rc),
+                  _dbl_ptr(forest.lv),
+                  forest.node_off.ctypes.data_as(pi),
+                  forest.leaf_off.ctypes.data_as(pi),
+                  forest.num_models, forest.num_class,
+                  ctypes.c_double(forest.sigmoid), np.int32(mode),
+                  buf, cap, nthreads or default_threads(),
+                  ctypes.byref(seen))
+        if fmt == "libsvm":
+            got = lib.lgt_predict_libsvm_mt(text, len(text), num_feat,
+                                            *common)
+        else:
+            got = lib.lgt_predict_dense_mt(text, len(text),
+                                           sep.encode()[0], label_idx,
+                                           num_feat, *common)
+        return got, buf
+
+    got, buf = run(cap)
+    if got == _OVERFLOW:
+        got, buf = run(int(seen.value * per_row + 16))
+    if got == _OVERFLOW:  # exact-count cap exceeded: cannot happen for
+        return None       # finite "%g" output — fall back to the slow path
+    if got < 0:
+        from ..utils import log
+        log.fatal("Unknown token in data file at row %d"
+                  % (row0 + (-got - 1)))
+    return ctypes.string_at(buf, got), int(seen.value)

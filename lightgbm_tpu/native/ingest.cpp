@@ -26,6 +26,7 @@
 #include <cstring>
 #include <limits>
 #include <locale.h>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -434,8 +435,14 @@ int64_t lgt_parse_bin_dense_mt(
         }
         int32_t act = col_map[c];
         if (act >= 0)
+          // dense parsers drop |v| <= 1e-10 features to the value-0
+          // default (reference parser.hpp:32,62 never emit them; the
+          // DenseBin default is ValueToBin(0), dense_bin.hpp:19-24).
+          // Labels/weights/qids below keep tiny values, like the
+          // reference's label assignment before the cutoff.
           trow[act * BinTile::TILE] =
-              bin_of(v, bounds + boffs[act], num_bins[act]);
+              bin_of(std::fabs(v) > 1e-10 ? v : 0.0,
+                     bounds + boffs[act], num_bins[act]);
         else if (act == -2)
           label_out[out] = static_cast<float>(v);
         else if (act == -3 && weight_out)
@@ -987,6 +994,369 @@ void lgt_bin_values(const double* vals, int64_t n, const double* bounds,
     }
     out[i] = static_cast<uint8_t>(lo);
   }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native task=predict fast path: fused parse -> tree descent -> transform ->
+// "%g" format in one multithreaded pass, the warm-process equivalent of the
+// reference Predictor (src/application/predictor.hpp:82-130) without the JAX
+// runtime in the loop.  Byte-identical semantics:
+//   - fields parse with the reference Atof arithmetic (parse_value above);
+//   - dense parsers drop |v| <= 1e-10 to zero (parser.hpp:32,62) while
+//     libsvm keeps every idx:val pair (parser.hpp:94-103);
+//   - descent compares value <= threshold (tree.h:179-189, GetLeaf);
+//   - per-class sums accumulate doubles in model order i*num_class+j
+//     (gbdt.cpp:487-510, PredictRaw/Predict);
+//   - the sigmoid transform replicates `1.0f/(1.0f+exp(-2.0f*sigmoid*s))`
+//     including the float literals (gbdt.cpp:506) and Common::Softmax's
+//     max-shift order (common.h:353-366);
+//   - output lines are '\t'-joined "%g" fields (Common::Join's default
+//     ostream formatting) with one row per input line.
+
+namespace {
+
+// Flattened forest: per-model inner-node arrays at node_off[m] and leaf
+// values at leaf_off[m].  num_models = num_used_iterations * num_class.
+struct Forest {
+  const int32_t* sf;       // split_feature_real
+  const double* thr;
+  const int32_t* lc;
+  const int32_t* rc;
+  const double* lv;        // leaf values
+  const int64_t* node_off;  // [num_models + 1]
+  const int64_t* leaf_off;  // [num_models + 1]
+  int64_t num_models;
+  int64_t num_class;
+  double sigmoid;
+  int32_t mode;            // 0 = transformed, 1 = raw score, 2 = leaf index
+};
+
+// One branchless descent step: finished rows (n < 0) re-load node 0
+// harmlessly and keep their leaf.  The unconditional loads keep 4
+// independent chains in flight per loop (below), which is what hides the
+// ~4-cycle L1 latency of the node->child pointer chase — a straight
+// per-row `while (node >= 0)` loop measured ~3x slower on the 1M-row
+// bench (one mispredicted exit per row per tree).
+inline int32_t desc_step(const double* x, const int32_t* sf,
+                         const double* thr, const int32_t* lc,
+                         const int32_t* rc, int32_t n) {
+  int32_t i = n & ~(n >> 31);  // max(n, 0) without a branch
+  int32_t l = lc[i], r = rc[i];
+  // load both children first so the select is register-register: gcc
+  // emits cmov and the (data-dependent, ~50% taken) comparison never
+  // becomes a mispredicting branch
+  int32_t nxt = x[sf[i]] <= thr[i] ? l : r;
+  return n < 0 ? n : nxt;
+}
+
+// Leaf index of model m for nb buffered rows (X row-major [nb, num_feat]),
+// 4 rows interleaved.  Identical result to per-row GetLeaf descent.
+inline void tree_leaves(const Forest& F, int64_t m, const double* X,
+                        int64_t num_feat, int64_t nb, int32_t* out) {
+  const int64_t o = F.node_off[m];
+  if (F.node_off[m + 1] == o) {  // single-leaf tree
+    for (int64_t b = 0; b < nb; ++b) out[b] = 0;
+    return;
+  }
+  const int32_t* sf = F.sf + o;
+  const double* thr = F.thr + o;
+  const int32_t* lc = F.lc + o;
+  const int32_t* rc = F.rc + o;
+  int64_t b = 0;
+  for (; b + 8 <= nb; b += 8) {
+    const double* x0 = X + (b + 0) * num_feat;
+    const double* x1 = X + (b + 1) * num_feat;
+    const double* x2 = X + (b + 2) * num_feat;
+    const double* x3 = X + (b + 3) * num_feat;
+    const double* x4 = X + (b + 4) * num_feat;
+    const double* x5 = X + (b + 5) * num_feat;
+    const double* x6 = X + (b + 6) * num_feat;
+    const double* x7 = X + (b + 7) * num_feat;
+    int32_t n0 = 0, n1 = 0, n2 = 0, n3 = 0;
+    int32_t n4 = 0, n5 = 0, n6 = 0, n7 = 0;
+    // any row still descending
+    while ((n0 & n1 & n2 & n3 & n4 & n5 & n6 & n7) >= 0) {
+      n0 = desc_step(x0, sf, thr, lc, rc, n0);
+      n1 = desc_step(x1, sf, thr, lc, rc, n1);
+      n2 = desc_step(x2, sf, thr, lc, rc, n2);
+      n3 = desc_step(x3, sf, thr, lc, rc, n3);
+      n4 = desc_step(x4, sf, thr, lc, rc, n4);
+      n5 = desc_step(x5, sf, thr, lc, rc, n5);
+      n6 = desc_step(x6, sf, thr, lc, rc, n6);
+      n7 = desc_step(x7, sf, thr, lc, rc, n7);
+    }
+    out[b + 0] = ~n0;
+    out[b + 1] = ~n1;
+    out[b + 2] = ~n2;
+    out[b + 3] = ~n3;
+    out[b + 4] = ~n4;
+    out[b + 5] = ~n5;
+    out[b + 6] = ~n6;
+    out[b + 7] = ~n7;
+  }
+  for (; b < nb; ++b) {
+    const double* x = X + b * num_feat;
+    int32_t node = 0;
+    while (node >= 0)
+      node = x[sf[node]] <= thr[node] ? lc[node] : rc[node];
+    out[b] = ~node;
+  }
+}
+
+// Rows buffered per block before descending: big enough to amortize the
+// tree-outer loop (node arrays stay L1/L2-hot across rows), capped so
+// X = block * num_feat doubles stays cache-resident even for wide
+// (libsvm) models.
+inline int64_t predict_block_rows(int64_t num_feat) {
+  // keep X within ~L1 (32 KB budget): the x[sf[node]] load sits on the
+  // descent's serial dependency chain, so an L2-resident block adds
+  // ~10 cycles to every level of every tree
+  int64_t b = (32 << 10) / (num_feat > 0 ? num_feat * 8 : 8);
+  if (b > 512) b = 512;
+  if (b < 8) b = 8;
+  return b;
+}
+
+// Descend + transform + format nb buffered rows into s.  leaves is a
+// [block] i32 scratch; acc a [block * num_class] f64 scratch; lvidx
+// (mode 2 only) a [block * num_models] i32 scratch.
+inline void predict_flush(const Forest& F, const double* X, int64_t num_feat,
+                          int64_t nb, int32_t* leaves, double* acc,
+                          int32_t* lvidx, std::string* s) {
+  char tmp[32];
+  if (F.mode == 2) {
+    for (int64_t m = 0; m < F.num_models; ++m) {
+      tree_leaves(F, m, X, num_feat, nb, leaves);
+      for (int64_t b = 0; b < nb; ++b) lvidx[b * F.num_models + m] = leaves[b];
+    }
+    for (int64_t b = 0; b < nb; ++b) {
+      for (int64_t m = 0; m < F.num_models; ++m) {
+        if (m) s->push_back('\t');
+        int n = snprintf(tmp, sizeof(tmp), "%d", lvidx[b * F.num_models + m]);
+        s->append(tmp, n);
+      }
+      s->push_back('\n');
+    }
+    return;
+  }
+  for (int64_t b = 0; b < nb * F.num_class; ++b) acc[b] = 0.0;
+  // tree-outer, rows-inner: per row the additions still happen in model
+  // order m = 0..num_models-1, so the double accumulation is bit-identical
+  // to the reference's per-row loop (gbdt.cpp:487-494)
+  for (int64_t m = 0; m < F.num_models; ++m) {
+    tree_leaves(F, m, X, num_feat, nb, leaves);
+    const double* lv = F.lv + F.leaf_off[m];
+    double* a = acc + (m % F.num_class);
+    for (int64_t b = 0; b < nb; ++b)
+      a[b * F.num_class] += lv[leaves[b]];
+  }
+  for (int64_t b = 0; b < nb; ++b) {
+    double* ret = acc + b * F.num_class;
+    if (F.mode == 0) {
+      if (F.sigmoid > 0 && F.num_class == 1) {
+        ret[0] = 1.0f / (1.0f + std::exp(-2.0f * F.sigmoid * ret[0]));
+      } else if (F.num_class > 1) {
+        double wmax = ret[0];
+        for (int64_t j = 1; j < F.num_class; ++j)
+          wmax = std::max(ret[j], wmax);
+        double wsum = 0.0f;
+        for (int64_t j = 0; j < F.num_class; ++j) {
+          ret[j] = std::exp(ret[j] - wmax);
+          wsum += ret[j];
+        }
+        for (int64_t j = 0; j < F.num_class; ++j) ret[j] /= wsum;
+      }
+    }
+    for (int64_t j = 0; j < F.num_class; ++j) {
+      if (j) s->push_back('\t');
+      int n = snprintf(tmp, sizeof(tmp), "%g", ret[j]);
+      s->append(tmp, n);
+    }
+    s->push_back('\n');
+  }
+}
+
+// Per-thread block state for the predict workers: rows buffered into X
+// then flushed through predict_flush.
+struct PredictBlock {
+  int64_t cap, num_feat, nb = 0;
+  std::vector<double> X;
+  std::vector<int32_t> leaves;
+  std::vector<double> acc;
+  std::vector<int32_t> lvidx;
+  PredictBlock(const Forest& F, int64_t nf)
+      : cap(predict_block_rows(nf)), num_feat(nf),
+        X(static_cast<size_t>(cap) * nf, 0.0),
+        leaves(cap),
+        acc(static_cast<size_t>(cap) * F.num_class),
+        lvidx(F.mode == 2 ? static_cast<size_t>(cap) * F.num_models : 0) {}
+  double* row() { return X.data() + nb * num_feat; }
+  void flush(const Forest& F, std::string* s) {
+    if (!nb) return;
+    predict_flush(F, X.data(), num_feat, nb, leaves.data(), acc.data(),
+                  lvidx.data(), s);
+    std::fill(X.begin(), X.begin() + nb * num_feat, 0.0);
+    nb = 0;
+  }
+};
+
+// Join per-thread output strings in order into the caller's buffer.
+inline int64_t gather_outputs(const std::vector<std::string>& outs,
+                              char* out, int64_t out_cap) {
+  int64_t total = 0;
+  for (const auto& s : outs) total += static_cast<int64_t>(s.size());
+  if (total > out_cap) return kOverflow;
+  char* q = out;
+  for (const auto& s : outs) {
+    std::memcpy(q, s.data(), s.size());
+    q += s.size();
+  }
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Dense CSV/TSV chunk -> formatted prediction text.  Returns bytes
+// written, -(chunk_row+1) for the earliest parse error, or kOverflow if
+// out_cap is too small.  The caller skips any header line and aligns
+// chunks to line boundaries.
+int64_t lgt_predict_dense_mt(
+    const char* buf, int64_t len, char sep, int64_t label_idx,
+    int64_t num_feat, const int32_t* sf, const double* thr,
+    const int32_t* lc, const int32_t* rc, const double* lv,
+    const int64_t* node_off, const int64_t* leaf_off, int64_t num_models,
+    int64_t num_class, double sigmoid, int32_t mode, char* out,
+    int64_t out_cap, int32_t nthreads, int64_t* rows_seen_out) {
+  const Forest F{sf, thr, lc, rc, lv, node_off, leaf_off,
+                 num_models, num_class, sigmoid, mode};
+  int nt = resolve_threads(nthreads, len);
+  ThreadPlan plan;
+  plan_ranges(buf, len, nt, nullptr, 0, &plan);
+  // the exact row count (callers size a kOverflow retry buffer from it,
+  // saving the separate lgt_count_lines pass over the chunk)
+  *rows_seen_out = plan.row0[nt];
+  std::atomic<int64_t> err(-1);
+  std::vector<std::string> outs(nt);
+  auto worker = [&](int t) {
+    const char* p = plan.cuts[t];
+    const char* end = plan.cuts[t + 1];
+    const char terms[2] = {sep, 0};
+    int64_t row = plan.row0[t];
+    bool ok = true;
+    std::string& s = outs[t];
+    PredictBlock blk(F, num_feat);
+    while (p < end) {
+      while (p < end && is_eol(*p)) ++p;
+      if (p >= end) break;
+      const char* line_end = p;
+      while (line_end < end && !is_eol(*line_end)) ++line_end;
+      if (line_end == p) continue;
+      double* x = blk.row();
+      int64_t idx = 0, bias = 0;
+      while (p < line_end) {
+        double v = parse_value(p, line_end, terms, &p, &ok);
+        if (!ok) {
+          record_err(&err, row);
+          return;
+        }
+        if (idx == label_idx) {
+          bias = -1;  // parsed and discarded (Predictor ignores labels)
+        } else if (std::fabs(v) > 1e-10) {
+          int64_t f = idx + bias;
+          if (f >= 0 && f < num_feat) x[f] = v;
+        }
+        ++idx;
+        while (p < line_end && *p != sep) ++p;
+        if (p < line_end) ++p;
+      }
+      if (++blk.nb == blk.cap) blk.flush(F, &s);
+      p = line_end;
+      ++row;
+    }
+    blk.flush(F, &s);
+  };
+  {
+    std::vector<std::thread> th;
+    for (int t = 0; t < nt; ++t) th.emplace_back(worker, t);
+    for (auto& x : th) x.join();
+  }
+  int64_t e = err.load();
+  if (e >= 0) return -(e + 1);
+  return gather_outputs(outs, out, out_cap);
+}
+
+// LibSVM chunk -> formatted prediction text.  Same contract as
+// lgt_predict_dense_mt; the leading label token is parsed and discarded,
+// idx:val pairs address features directly (parser.hpp:94-103), and
+// malformed tokens are skipped like lgt_parse_bin_libsvm_mt.
+int64_t lgt_predict_libsvm_mt(
+    const char* buf, int64_t len, int64_t num_feat, const int32_t* sf,
+    const double* thr, const int32_t* lc, const int32_t* rc,
+    const double* lv, const int64_t* node_off, const int64_t* leaf_off,
+    int64_t num_models, int64_t num_class, double sigmoid, int32_t mode,
+    char* out, int64_t out_cap, int32_t nthreads, int64_t* rows_seen_out) {
+  const Forest F{sf, thr, lc, rc, lv, node_off, leaf_off,
+                 num_models, num_class, sigmoid, mode};
+  int nt = resolve_threads(nthreads, len);
+  ThreadPlan plan;
+  plan_ranges(buf, len, nt, nullptr, 0, &plan);
+  *rows_seen_out = plan.row0[nt];
+  std::atomic<int64_t> err(-1);
+  std::vector<std::string> outs(nt);
+  auto worker = [&](int t) {
+    const char* p = plan.cuts[t];
+    const char* end = plan.cuts[t + 1];
+    int64_t row = plan.row0[t];
+    bool ok = true;
+    std::string& s = outs[t];
+    PredictBlock blk(F, num_feat);
+    while (p < end) {
+      while (p < end && is_eol(*p)) ++p;
+      if (p >= end) break;
+      const char* line_end = p;
+      while (line_end < end && !is_eol(*line_end)) ++line_end;
+      if (line_end == p) continue;
+      double* x = blk.row();
+      double v = parse_value(p, line_end, " \t", &p, &ok);  // label
+      if (!ok) {
+        record_err(&err, row);
+        return;
+      }
+      while (p < line_end) {
+        while (p < line_end && (*p == ' ' || *p == '\t')) ++p;
+        if (p >= line_end) break;
+        char* q = nullptr;
+        long long fidx = std::strtoll(p, &q, 10);
+        if (q == p || q >= line_end || *q != ':') {
+          while (p < line_end && *p != ' ' && *p != '\t') ++p;
+          continue;
+        }
+        p = q + 1;
+        v = parse_value(p, line_end, " \t:", &p, &ok);
+        if (!ok) {
+          record_err(&err, row);
+          return;
+        }
+        if (fidx >= 0 && fidx < num_feat) x[fidx] = v;
+      }
+      if (++blk.nb == blk.cap) blk.flush(F, &s);
+      p = line_end;
+      ++row;
+    }
+    blk.flush(F, &s);
+  };
+  {
+    std::vector<std::thread> th;
+    for (int t = 0; t < nt; ++t) th.emplace_back(worker, t);
+    for (auto& x : th) x.join();
+  }
+  int64_t e = err.load();
+  if (e >= 0) return -(e + 1);
+  return gather_outputs(outs, out, out_cap);
 }
 
 }  // extern "C"

@@ -18,6 +18,10 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+from .utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()   # before any jit traces (was a package-import side effect)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -21,6 +21,10 @@ from __future__ import annotations
 
 import functools
 
+from ..utils.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()   # before any jit traces (was a package-import side effect)
+
 import jax
 import jax.numpy as jnp
 

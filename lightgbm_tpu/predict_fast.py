@@ -1,0 +1,175 @@
+"""JAX-free native fast path for `task=predict`.
+
+The reference serves prediction from one warm process: TextReader blocks
+feed an OpenMP loop that parses, descends the trees and formats each row
+(src/application/predictor.hpp:82-130).  The framework's default predict
+path pays costs the reference never sees — Python+JAX import, TPU tunnel
+upload, device readback — which BASELINE.md measured at over half the
+end-to-end wall for a 1M-row file.  This module is the equivalent warm
+loop: the model text is parsed host-side (no jax import anywhere on this
+path), flattened into contiguous arrays, and each input chunk runs one
+fused native parse -> descend -> transform -> "%g" pass
+(native.predict_chunk / ingest.cpp lgt_predict_*_mt), streaming to the
+output file with bounded memory.
+
+Output is byte-identical to the default path (and to the reference
+binary): same Atof parse arithmetic, same `<= threshold` descent, same
+double accumulation order, same sigmoid/softmax expressions, same "%g"
+formatting.  test_predict_fast pins fast-vs-default identity across
+formats and modes; test_e2e_parity's golden predict tests run through
+this path via the CLI.
+
+Returns False from try_fast_predict when the native library is
+unavailable so cli.Application falls back to the JAX path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .config import Config
+from .io.parser import detect_format
+from .models.tree import Tree, parse_model_text
+from .utils import log
+
+# Input chunk size: large enough to amortize thread spawn per chunk,
+# small enough to bound memory for arbitrarily large inputs.
+CHUNK_BYTES = 64 << 20
+
+
+class _LightModel:
+    """Model-text header + trees, parsed without models.gbdt (which
+    imports jax).  The actual reader is models.tree.parse_model_text,
+    shared with GBDT.load_model_from_string so the two paths cannot
+    drift; sigmoid defaults like cli.init_predict's prediction-only
+    GBDT (no binary objective configured -> -1)."""
+
+    def __init__(self, model_str: str):
+        header, trees = parse_model_text(model_str)
+        self.num_class = header["num_class"]
+        self.label_idx = header["label_index"]
+        self.max_feature_idx = header["max_feature_idx"]
+        self.sigmoid = (header["sigmoid"]
+                        if header["sigmoid"] is not None else -1.0)
+        self.trees: List[Tree] = trees
+
+    def used_trees(self, num_model_predict: int) -> List[Tree]:
+        """cli.init_predict's set_num_used_model call, resolved:
+        num_model_predict counts ITERATIONS; each holds num_class
+        trees (gbdt.cpp:455-456)."""
+        num_used = len(self.trees) // self.num_class
+        if num_model_predict >= 0:
+            num_used = min(num_model_predict, num_used)
+        return self.trees[:num_used * self.num_class]
+
+
+def _read_chunks(path: str, has_header: bool):
+    """Yield line-aligned byte chunks of the input file, skipping the
+    first NON-blank line when has_header (matching io/dataset
+    _skip_header and cli.predict's blocks())."""
+    with open(path, "rb") as f:
+        carry = b""
+        skip_header = has_header
+        while True:
+            block = f.read(CHUNK_BYTES)
+            if not block:
+                break
+            buf = carry + block
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                carry = buf
+                continue
+            chunk, carry = buf[:cut + 1], buf[cut + 1:]
+            if skip_header:
+                chunk, skipped = _strip_header(chunk)
+                if not skipped:
+                    continue  # header line longer than the chunk: rare
+                skip_header = False
+            yield chunk
+        if carry:
+            if skip_header:
+                carry, skipped = _strip_header(carry)
+                if not skipped:
+                    return
+            if carry.strip(b"\r\n"):
+                yield carry
+
+
+def _strip_header(chunk: bytes) -> Tuple[bytes, bool]:
+    """Drop the first non-blank line; (rest, found)."""
+    pos = 0
+    while pos < len(chunk):
+        eol = chunk.find(b"\n", pos)
+        end = eol if eol >= 0 else len(chunk)
+        if chunk[pos:end].strip(b"\r"):
+            return (chunk[end + 1:] if eol >= 0 else b""), True
+        if eol < 0:
+            break
+        pos = eol + 1
+    return b"", False
+
+
+def _sniff_format(path: str, has_header: bool) -> Tuple[str, str]:
+    """(fmt, sep) from the first data lines (Parser::CreateParser role)."""
+    with open(path, "rb") as f:
+        head = f.read(1 << 20)
+    lines = [ln for ln in head.decode("utf-8", "replace").splitlines()
+             if ln.strip("\r")]
+    if has_header and lines:
+        lines = lines[1:]
+    fmt = detect_format(lines[:2])
+    return fmt, ("," if fmt == "csv" else "\t")
+
+
+def try_fast_predict(cfg: Config) -> bool:
+    """Run task=predict through the native path; False -> caller falls
+    back to the default JAX path (native toolchain unavailable)."""
+    from . import native
+    if native.get_lib() is None:
+        return False
+    if not cfg.input_model:
+        log.fatal("Need a model file for prediction (input_model)")
+    log.info("Started prediction...")
+    with open(cfg.input_model) as f:
+        model = _LightModel(f.read())
+    trees = model.used_trees(cfg.num_model_predict)
+    forest = native.ForestSpec(trees, model.num_class, model.sigmoid)
+    mode = (2 if cfg.is_predict_leaf_index
+            else 1 if cfg.is_predict_raw_score else 0)
+    num_feat = model.max_feature_idx + 1
+    fmt, sep = _sniff_format(cfg.data, cfg.has_header)
+
+    # pull the first chunk BEFORE opening (truncating) the output file so
+    # an empty input fatals without clobbering a previous result (same
+    # no-clobber contract as cli.predict)
+    gen = _read_chunks(cfg.data, cfg.has_header)
+    first: Optional[bytes] = None
+    row0 = 0
+    for chunk in gen:
+        got = native.predict_chunk(chunk, fmt, sep, model.label_idx,
+                                   num_feat, forest, mode, row0=row0)
+        if got is None:
+            return False  # native refused (capacity edge): slow path
+        blob, rows = got
+        row0 += rows
+        if blob:
+            first = blob
+            break
+    if first is None:
+        log.fatal("Data file %s is empty" % cfg.data)
+    with open(cfg.output_result, "wb") as out_f:
+        out_f.write(first)
+        for chunk in gen:
+            got = native.predict_chunk(chunk, fmt, sep, model.label_idx,
+                                       num_feat, forest, mode, row0=row0)
+            if got is None:
+                # mid-file native refusal: finishing through two paths
+                # would interleave buffers — fatal rather than corrupt
+                log.fatal("Native predict failed mid-file on %s" % cfg.data)
+            blob, rows = got
+            row0 += rows
+            out_f.write(blob)
+    log.info("Finished prediction, results saved to %s" % cfg.output_result)
+    return True

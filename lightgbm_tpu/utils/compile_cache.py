@@ -7,9 +7,12 @@ cost per machine: later processes deserialize the compiled executable in
 well under a second, which is what makes cold-process wall-clock
 competitive (BASELINE.md).
 
-Enabled on package import (see lightgbm_tpu/__init__.py).  Opt out with
-LGBM_TPU_NO_COMPILE_CACHE=1 (LIGHTGBM_TPU_NO_CACHE=1 also accepted);
-override the location with LIGHTGBM_TPU_CACHE_DIR.
+Enabled by the modules that trace jits (ops/histogram, ops/split,
+ops/predict, ops/hist_pallas, objectives) before their first compile —
+NOT on package import, which stays jax-free so the native task=predict
+fast path (predict_fast.py) skips the JAX startup cost entirely.  Opt
+out with LGBM_TPU_NO_COMPILE_CACHE=1 (LIGHTGBM_TPU_NO_CACHE=1 also
+accepted); override the location with LIGHTGBM_TPU_CACHE_DIR.
 """
 
 import os

@@ -1,0 +1,299 @@
+"""Native task=predict fast path (predict_fast.py + ingest.cpp
+lgt_predict_*_mt) vs the default JAX path.
+
+The fast path is the framework's answer to the reference's warm-process
+Predictor (src/application/predictor.hpp:82-130): one process, fused
+parse -> descend -> transform -> format, no device round trip.  These
+tests pin byte-identity between the two in-repo paths across formats
+(tsv/csv/libsvm), modes (normal/raw/leaf), ragged + na inputs, multiclass
+softmax, num_model_predict truncation, multi-chunk streaming, and the
+empty-input no-clobber contract.  Byte-identity against the REFERENCE
+BINARY itself is pinned by test_e2e_parity.test_predict_task_parity,
+which routes through this same fast path via the CLI.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application
+
+pytestmark = pytest.mark.skipif(
+    __import__("lightgbm_tpu.native", fromlist=["native"]).get_lib() is None,
+    reason="native library unavailable")
+
+
+# Hand-written models (Tree text fields as Tree::ToString emits them) so
+# the tests need no training step.
+BINARY_MODEL = """gbdt
+num_class=1
+label_index=0
+max_feature_idx=3
+sigmoid=1
+objective=binary
+
+Tree=0
+num_leaves=3
+split_feature=0 2
+split_gain=1 0.5
+threshold=0.5 -0.25
+left_child=1 -2
+right_child=-1 -3
+leaf_parent=0 1 1
+leaf_value=0.2 -0.13 0.34
+internal_value=0 0.1
+
+Tree=1
+num_leaves=2
+split_feature=3
+split_gain=0.25
+threshold=1.5e-11
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=-0.05 0.07
+internal_value=0
+
+Tree=2
+num_leaves=2
+split_feature=1
+split_gain=0.1
+threshold=-2.75
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=0.011 -0.014
+internal_value=0
+
+feature importance:
+"""
+
+MULTI_MODEL = """gbdt
+num_class=3
+label_index=0
+max_feature_idx=2
+objective=multiclass
+
+Tree=0
+num_leaves=2
+split_feature=0
+split_gain=1
+threshold=0.1
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=0.4 -0.2
+internal_value=0
+
+Tree=1
+num_leaves=2
+split_feature=1
+split_gain=1
+threshold=-0.3
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=0.1 -0.3
+internal_value=0
+
+Tree=2
+num_leaves=2
+split_feature=2
+split_gain=1
+threshold=0.7
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=-0.6 0.2
+internal_value=0
+
+Tree=3
+num_leaves=2
+split_feature=1
+split_gain=1
+threshold=0.2
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=0.15 -0.12
+internal_value=0
+
+Tree=4
+num_leaves=2
+split_feature=0
+split_gain=1
+threshold=-0.4
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=-0.21 0.3
+internal_value=0
+
+Tree=5
+num_leaves=2
+split_feature=2
+split_gain=1
+threshold=0
+left_child=-1
+right_child=-2
+leaf_parent=0 0
+leaf_value=0.17 -0.02
+internal_value=0
+
+feature importance:
+"""
+
+
+def _write_dense(path, rows, sep):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(sep.join(r) + "\n")
+
+
+def _rows(n=400, f=4, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    rows = []
+    for i in range(n):
+        vals = ["%.6g" % v for v in x[i]]
+        if i % 23 == 5:
+            vals[1] = "na"          # -> 0.0 (Atof token rule)
+        if i % 37 == 11:
+            vals = vals[:2]         # ragged short row
+        if i % 41 == 13:
+            vals[0] = "4.9e-11"     # |v| <= 1e-10 dense drop rule
+        rows.append(["%g" % (i % 2)] + vals)
+    return rows
+
+
+def _run_both(tmp_path, model_text, data_name, extra=(), monkeypatch=None):
+    model = str(tmp_path / "model.txt")
+    with open(model, "w") as f:
+        f.write(model_text)
+    outs = {}
+    for tag, env in (("fast", None), ("slow", "1")):
+        out = str(tmp_path / ("out_%s.txt" % tag))
+        if env is None:
+            os.environ.pop("LGBM_TPU_NO_FAST_PREDICT", None)
+        else:
+            os.environ["LGBM_TPU_NO_FAST_PREDICT"] = env
+        try:
+            Application(["task=predict", "data=" + str(tmp_path / data_name),
+                         "input_model=" + model, "output_result=" + out,
+                         "device_type=cpu"] + list(extra)).run()
+        finally:
+            os.environ.pop("LGBM_TPU_NO_FAST_PREDICT", None)
+        with open(out, "rb") as f:
+            outs[tag] = f.read()
+    assert outs["fast"], "empty prediction output"
+    return outs["fast"], outs["slow"]
+
+
+@pytest.mark.parametrize("mode", [(), ("predict_raw_score=true",),
+                                  ("predict_leaf_index=true",)],
+                         ids=["normal", "raw", "leaf"])
+@pytest.mark.parametrize("fmt", ["tsv", "csv", "libsvm"])
+def test_fast_matches_default_binary(tmp_path, fmt, mode):
+    rows = _rows()
+    if fmt == "libsvm":
+        with open(tmp_path / "d.txt", "w") as f:
+            for r in rows:
+                pairs = ["%d:%s" % (i, t) for i, t in enumerate(r[1:])
+                         if t != "na"]
+                f.write(" ".join([r[0]] + pairs) + "\n")
+    else:
+        _write_dense(tmp_path / "d.txt", rows,
+                     "\t" if fmt == "tsv" else ",")
+    fast, slow = _run_both(tmp_path, BINARY_MODEL, "d.txt", mode)
+    assert fast == slow
+
+
+@pytest.mark.parametrize("mode", [(), ("predict_raw_score=true",)],
+                         ids=["normal", "raw"])
+def test_fast_matches_default_multiclass(tmp_path, mode):
+    _write_dense(tmp_path / "d.tsv", _rows(f=3), "\t")
+    fast, slow = _run_both(tmp_path, MULTI_MODEL, "d.tsv", mode)
+    assert fast == slow
+    if not mode:  # softmax rows sum to ~1
+        vals = np.array([[float(v) for v in ln.split("\t")]
+                         for ln in fast.decode().splitlines()])
+        assert vals.shape[1] == 3
+        # %g prints 6 significant digits, so row sums carry ~1e-6 noise
+        np.testing.assert_allclose(vals.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_num_model_predict_truncates(tmp_path):
+    _write_dense(tmp_path / "d.tsv", _rows(), "\t")
+    fast, slow = _run_both(tmp_path, BINARY_MODEL, "d.tsv",
+                           ("num_model_predict=1",))
+    assert fast == slow
+    # 1 used iteration: leaf mode emits one column
+    fast_leaf, slow_leaf = _run_both(
+        tmp_path, BINARY_MODEL, "d.tsv",
+        ("num_model_predict=1", "predict_leaf_index=true"))
+    assert fast_leaf == slow_leaf
+    assert all(len(ln.split("\t")) == 1
+               for ln in fast_leaf.decode().splitlines())
+
+
+def test_has_header_skips_first_line(tmp_path):
+    rows = _rows(n=50)
+    with open(tmp_path / "d.tsv", "w") as f:
+        f.write("label\tf0\tf1\tf2\tf3\n")
+        for r in rows:
+            f.write("\t".join(r) + "\n")
+    fast, slow = _run_both(tmp_path, BINARY_MODEL, "d.tsv", ("header=true",))
+    assert fast == slow
+    assert len(fast.splitlines()) == 50
+
+
+def test_multi_chunk_streaming(tmp_path, monkeypatch):
+    """Chunked streaming concatenates byte-identically to one-shot."""
+    import lightgbm_tpu.predict_fast as pf
+    _write_dense(tmp_path / "d.tsv", _rows(n=997), "\t")
+    fast_one, _ = _run_both(tmp_path, BINARY_MODEL, "d.tsv")
+    monkeypatch.setattr(pf, "CHUNK_BYTES", 1 << 12)  # ~50-line chunks
+    fast_many, _ = _run_both(tmp_path, BINARY_MODEL, "d.tsv")
+    assert fast_one == fast_many
+    assert len(fast_many.splitlines()) == 997
+
+
+def test_empty_input_no_clobber(tmp_path):
+    """Empty data file fatals WITHOUT truncating an existing result
+    (cli.predict's contract, preserved by the fast path)."""
+    model = str(tmp_path / "model.txt")
+    with open(model, "w") as f:
+        f.write(BINARY_MODEL)
+    data = str(tmp_path / "empty.tsv")
+    with open(data, "w") as f:
+        f.write("\n\n")
+    out = str(tmp_path / "out.txt")
+    with open(out, "w") as f:
+        f.write("precious")
+    rc = __import__("lightgbm_tpu.cli", fromlist=["main"]).main(
+        ["task=predict", "data=" + data, "input_model=" + model,
+         "output_result=" + out])
+    assert rc != 0
+    with open(out) as f:
+        assert f.read() == "precious"
+
+
+def test_tiny_threshold_dense_drop_rule(tmp_path):
+    """Dense parsers zero |v| <= 1e-10 (reference parser.hpp:32,62), so a
+    value below the cutoff goes LEFT of Tree=1's 1.5e-11 threshold even
+    though its literal value is larger; libsvm keeps the raw value and
+    goes right.  Pins the parser-level rule the reference applies."""
+    with open(tmp_path / "d.tsv", "w") as f:
+        f.write("0\t1\t1\t1\t9e-11\n")   # dropped to 0 -> leaf 0 of Tree=1
+    with open(tmp_path / "d.svm", "w") as f:
+        f.write("0 0:1 1:1 2:1 3:9e-11\n")  # kept -> 9e-11 > 1.5e-11 -> leaf 1
+    fast_dense, slow_dense = _run_both(
+        tmp_path, BINARY_MODEL, "d.tsv", ("predict_leaf_index=true",))
+    assert fast_dense == slow_dense
+    fast_svm, slow_svm = _run_both(
+        tmp_path, BINARY_MODEL, "d.svm", ("predict_leaf_index=true",))
+    assert fast_svm == slow_svm
+    t1_dense = int(fast_dense.split(b"\t")[1])
+    t1_svm = int(fast_svm.split(b"\t")[1])
+    assert t1_dense == 0 and t1_svm == 1
