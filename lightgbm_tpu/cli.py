@@ -179,7 +179,10 @@ class Application:
             lines = [ln for ln in f.read().splitlines() if ln]
         if self.config.has_header:
             lines = lines[1:]
-        _, feats, _ = parse_file_lines(lines, ds.label_idx)
+        # dense width fixed to the OLD model's schema, like the
+        # reference's Predictor-based init-score pass (predictor.hpp)
+        w = max(self.boosting_old.max_feature_idx + 2, ds.label_idx + 1)
+        _, feats, _ = parse_file_lines(lines, ds.label_idx, dense_cols=w)
         if ds.local_rows is not None:
             # rank-sharded dataset: predict only this rank's rows so the
             # init scores align with the local shard at 1/P the traversal
@@ -281,28 +284,21 @@ class Application:
                 yield buf
 
         fmt = [None]
-        width = [None]
 
         def parse(lines):
-            _, feats, f = parse_file_lines(lines, label_idx, fmt[0])
+            # dense blocks parse at the MODEL's width (+1 for the label
+            # column): the reference Predictor reads every field of every
+            # line and drops only feature indices >= num_features
+            # (parser.hpp:20-43, predictor.hpp PutFeatureValuesToBuffer),
+            # so ragged rows — shorter OR wider than the first — behave
+            # exactly like the reference's (and the native fast path's)
+            _, feats, f = parse_file_lines(
+                lines, label_idx, fmt[0],
+                dense_cols=max(n_total_feat + 1, label_idx + 1))
             fmt[0] = f  # sniff once, reuse for every later block
-            if f != "libsvm":
-                # dense: the FILE's first row fixes the column count,
-                # exactly as the whole-file parse did — later ragged rows
-                # truncate / zero-fill to it
-                if width[0] is None:
-                    width[0] = feats.shape[1]
-                w = width[0]
-                if feats.shape[1] < w:
-                    feats = np.pad(feats,
-                                   ((0, 0), (0, w - feats.shape[1])))
-                elif feats.shape[1] > w:
-                    feats = feats[:, :w]
-            # normalize every block to the MODEL's width: libsvm blocks
-            # vary with their own max index (must not cap later blocks at
-            # the first block's), columns past max_feature_idx are never
-            # read by any tree, and one stable width keeps one compiled
-            # traversal executable across blocks
+            # libsvm blocks vary with their own max index; normalize to
+            # the model's width so one compiled traversal executable
+            # covers every block
             if feats.shape[1] < n_total_feat:
                 feats = np.pad(
                     feats, ((0, 0), (0, n_total_feat - feats.shape[1])))

@@ -113,20 +113,25 @@ def _clean_token(tok: str) -> float:
     return min(max(v, -1e308), 1e308)
 
 
-def parse_dense(lines: List[str], sep: str, label_idx: int
+def parse_dense(lines: List[str], sep: str, label_idx: int,
+                ncols: Optional[int] = None
                 ) -> Tuple[np.ndarray, np.ndarray]:
     """Parse delimiter-separated rows -> (label [N] f64, features [N, C-1] f64).
 
     Feature indices have the label column removed and shifted, exactly like
-    CSVParser/TSVParser (reference src/io/parser.hpp:15-75).
-    """
+    CSVParser/TSVParser (reference src/io/parser.hpp:15-75).  The column
+    count comes from the FIRST row (the loader's schema rule) unless the
+    caller fixes `ncols` — prediction fixes it to the MODEL's width, since
+    the reference Predictor parses every field of every line and drops
+    only feature indices >= num_features (parser.hpp:20-43 +
+    predictor.hpp PutFeatureValuesToBuffer)."""
     rows = [line.rstrip("\r\n").split(sep) for line in lines]
     # token-by-token so every value goes through the reference's exact
     # Atof arithmetic (_clean_token) — a vectorized np.array parse is
     # correctly-rounded and diverges by ulps on e.g. "1.457" (see
     # _atof_value); the native parser (ingest.cpp) is the fast path,
     # this fallback favors bit-parity over speed
-    ncol = len(rows[0])
+    ncol = ncols if ncols is not None else len(rows[0])
     data = np.empty((len(rows), ncol), dtype=np.float64)
     for i, toks in enumerate(rows):
         vals = [_clean_token(t) for t in toks[:ncol]]
@@ -179,14 +184,16 @@ def parse_libsvm(lines: List[str], label_idx: int
     return label, feats
 
 
-def _native_parse(lines: List[str], label_idx: int, fmt: str):
+def _native_parse(lines: List[str], label_idx: int, fmt: str,
+                  dense_cols: Optional[int] = None):
     """Single-pass C++ parser (native/ingest.cpp); None -> fall back."""
     from .. import native
     if native.get_lib() is None:
         return None
     text = "\n".join(lines).encode("utf-8", errors="replace")
     if fmt in ("tsv", "csv"):
-        data = native.parse_dense(text, "\t" if fmt == "tsv" else ",")
+        data = native.parse_dense(text, "\t" if fmt == "tsv" else ",",
+                                  cols=dense_cols)
         if data is None or data.shape[0] != len(lines):
             return None
         label = data[:, label_idx].copy()
@@ -199,7 +206,8 @@ def _native_parse(lines: List[str], label_idx: int, fmt: str):
 
 
 def parse_file_lines(lines: List[str], label_idx: int,
-                     fmt: Optional[str] = None
+                     fmt: Optional[str] = None,
+                     dense_cols: Optional[int] = None
                      ) -> Tuple[np.ndarray, np.ndarray, str]:
     # non-empty = has any non-EOL character, like the native scanner and
     # the reference's TextReader (whitespace-only lines are rows of
@@ -208,13 +216,13 @@ def parse_file_lines(lines: List[str], label_idx: int,
     if not lines:
         log.fatal("Data file is empty")
     fmt = fmt or detect_format(lines)
-    nat = _native_parse(lines, label_idx, fmt)
+    nat = _native_parse(lines, label_idx, fmt, dense_cols)
     if nat is not None:
         return nat[0], nat[1], fmt
     if fmt == "tsv":
-        label, feats = parse_dense(lines, "\t", label_idx)
+        label, feats = parse_dense(lines, "\t", label_idx, dense_cols)
     elif fmt == "csv":
-        label, feats = parse_dense(lines, ",", label_idx)
+        label, feats = parse_dense(lines, ",", label_idx, dense_cols)
     else:
         label, feats = parse_libsvm(lines, label_idx)
     return label, feats, fmt

@@ -102,7 +102,7 @@ def _permute_packed_bag(packed: jax.Array, row_order: jax.Array):
     return jnp.take(_unpack_bag(packed, row_order.shape[0]), row_order)
 
 
-def _make_fused_step(grad_fn, grow_kw, lr, dtype):
+def _fused_step_body(grad_fn, grow_kw, lr, dtype):
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
              gstate, stopped):
         bag = _unpack_bag(bag_mask, bins.shape[1])
@@ -128,10 +128,15 @@ def _make_fused_step(grad_fn, grow_kw, lr, dtype):
             new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
         ints, floats = _pack_tree(dev_tree)
         return scores, new_valid, ints, floats, stopped
-    return jax.jit(step, donate_argnums=(0, 1))
+    return step
 
 
-def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype):
+def _make_fused_step(grad_fn, grow_kw, lr, dtype):
+    return jax.jit(_fused_step_body(grad_fn, grow_kw, lr, dtype),
+                   donate_argnums=(0, 1))
+
+
+def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype):
     """The fused step PLUS the ordered-partition row re-sort: after the
     tree lands, rows are stably re-sorted by its leaf assignment so later
     trees' leaves stay block-clustered and the block-list sweeps
@@ -170,9 +175,51 @@ def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype):
         order_new = jnp.take(row_order, rel)
         return (scores, new_valid, ints, floats, bins_new, bag_new,
                 gstate_new, order_new, stopped)
+    return step
+
+
+def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype):
     # gstate is NOT donated: on the first re-sort it aliases the
     # objective's own arrays, which must stay valid for metrics/restarts
-    return jax.jit(step, donate_argnums=(0, 1, 2, 4, 7))
+    return jax.jit(_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype),
+                   donate_argnums=(0, 1, 2, 4, 7))
+
+
+def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
+                             n_valid, gstate_specs, reorder):
+    """The fused step under shard_map for single-host tree_learner=data
+    (VERDICT r3 #2): per-row state (scores row, bins, bag mask, gradient
+    state, row order) shards along the data axis, valid sets and tree
+    outputs are replicated, and the ordered-partition re-sort — when
+    `reorder` — stays SHARD-LOCAL (each shard leaf-clusters its own
+    rows; grow_tree's psum'd histograms are order-invariant within a
+    shard, so the tree is identical to the unordered sharded tree).
+
+    Multi-host keeps the general path: its per-row state is process-
+    local and reassembled per tree (models/gbdt.py _train_tree)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    body = (_fused_step_body_reorder if reorder
+            else _fused_step_body)(grad_fn, grow_kw, lr, dtype)
+    row = P(DATA_AXIS)
+    row2 = P(None, DATA_AXIS)
+    rep = P()
+    vrep = [rep] * n_valid
+    common_in = (row2, vrep, row, rep, row2, tuple(vrep), gstate_specs)
+    if reorder:
+        in_specs = common_in + (row, rep)
+        out_specs = (row2, vrep, rep, rep, row2, row, gstate_specs,
+                     row, rep)
+        donate = (0, 1, 2, 4, 7)
+    else:
+        in_specs = common_in + (rep,)
+        out_specs = (row2, vrep, rep, rep, rep)
+        donate = (0, 1)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn, donate_argnums=donate)
 
 
 class GBDT:
@@ -188,6 +235,7 @@ class GBDT:
         self.iter = 0
         self._models: List = []       # Tree | _PendingTree (see models prop)
         self._stopped = False
+        self._fused_sharded = False
         self._flush_every = 1   # recomputed below once bagging state is known
         self.num_used_model = 0
         self.early_stopping_round = config.early_stopping_round
@@ -335,16 +383,27 @@ class GBDT:
             self.hist_compact = ((half + row_unit - 1)
                                  // row_unit) * row_unit
 
-        # ordered-partition growth (serial pallas learner): block-list
-        # sweeps are always on (bit-identical to full sweeps for a fixed
-        # row order — empty blocks contribute exact zeros); the row
-        # re-sort that makes them leaf-proportional additionally needs
-        # the fused path and a permutable objective.  Bagging composes:
-        # the in/out-of-bag draw stays pinned to FILE order (mt19937
-        # parity) and the mask permutes on device per re-bagging
-        # (_bag_mask_dev_fused).
+        # single-host tree_learner=data can run the fused step (and the
+        # ordered partition below) under shard_map: every per-row array
+        # shards along the data axis and re-sorts stay shard-local
+        # (_make_fused_step_sharded).  Multi-host keeps the general path
+        # (per-row state is process-local, reassembled per tree); voting
+        # keeps it too (its per-split protocol is latency-bound anyway).
+        self._fused_sharded = (self.rows_sharded and not self._mh
+                               and config.tree_learner == "data")
+
+        # ordered-partition growth (pallas learner, serial or single-host
+        # data-parallel): block-list sweeps are always on (bit-identical
+        # to full sweeps for a fixed row order — empty blocks contribute
+        # exact zeros); the row re-sort that makes them leaf-proportional
+        # additionally needs the fused path and a permutable objective.
+        # Bagging composes: the in/out-of-bag draw stays pinned to FILE
+        # order (mt19937 parity) and the mask permutes on device per
+        # re-bagging (_bag_mask_dev_fused).
         self.hist_ranged = (config.hist_ordered != "off"
-                            and impl == "pallas" and self.grower is None)
+                            and impl == "pallas"
+                            and (self.grower is None
+                                 or self._fused_sharded))
         if config.hist_compact == "on" and self.hist_ranged:
             log.warning("hist_compact=on disables hist_ordered "
                         "(mutually exclusive row-selection strategies)")
@@ -564,13 +623,14 @@ class GBDT:
         return self._bag_dev_packed[cls]
 
     def _can_fuse(self) -> bool:
-        """The fused single-dispatch iteration covers the serial single-
-        class path with a jax-traceable objective (regression/binary);
-        DART (per-iteration score surgery + varying shrinkage), custom
-        gradients, multiclass, and sharded growers take the general
-        path."""
+        """The fused single-dispatch iteration covers the single-class
+        path with a jax-traceable objective (regression/binary) on the
+        serial learner OR single-host tree_learner=data (shard_map
+        variant, _make_fused_step_sharded); DART (per-iteration score
+        surgery + varying shrinkage), custom gradients, multiclass,
+        multi-host and voting/feature growers take the general path."""
         return (type(self) is GBDT and self.num_class == 1
-                and self.grower is None
+                and (self.grower is None or self._fused_sharded)
                 and getattr(self.objective, "jax_traceable", False)
                 and self.objective.fused_key() is not None)
 
@@ -586,7 +646,17 @@ class GBDT:
         """Fused-path bag mask: bit-packed file-order upload normally;
         under an active row order, the cached ORDERED bool mask —
         rebuilt (unpack + one device take) only when re-bagging
-        invalidated it.  The reorder step keeps this cache permuted."""
+        invalidated it.  The reorder step keeps this cache permuted.
+        The SHARDED fused step always takes the bool mask: a packed byte
+        row only splits on shard boundaries when N_local % 8 == 0, which
+        the xla hist impl does not guarantee."""
+        if self._fused_sharded:
+            if self._bag_dev_packed[cls] is None:
+                m = jnp.asarray(self.bag_masks[cls])
+                if self._row_order is not None:
+                    m = jnp.take(m, self._row_order)
+                self._bag_dev_packed[cls] = m
+            return self._bag_dev_packed[cls]
         if self._row_order is None:
             return self._bag_mask_dev_packed(cls)
         if self._bag_dev_packed[cls] is None:
@@ -603,11 +673,18 @@ class GBDT:
                    and self._trees_since_reorder
                    >= (0 if self._row_order is None
                        else self.reorder_every - 1))
+        gstate = (self._gstate_override if self._gstate_override is not None
+                  else self.objective.grad_state())
         key = (self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
-               reorder)
+               reorder,
+               # sharded steps close over the mesh and the aggregation
+               # protocol — two data-parallel configs that differ only
+               # here MUST NOT share an executable
+               (cfg.hist_agg, self.grower.num_shards,
+                id(self.grower.mesh)) if self._fused_sharded else None)
         fn = _FUSED_STEPS.get(key)
         if fn is None:
             grow_kw = dict(max_leaves=max(cfg.num_leaves, 2),
@@ -617,17 +694,30 @@ class GBDT:
                            hist_slots=self.hist_slots,
                            compact=self.hist_compact,
                            ranged=self.hist_ranged)
-            make = (_make_fused_step_reorder if reorder
-                    else _make_fused_step)
-            fn = make(self.objective.make_grad_fn(), grow_kw, lr,
-                      self.dtype)
+            if self._fused_sharded:
+                from ..parallel.mesh import DATA_AXIS
+                from jax.sharding import PartitionSpec as P
+                grow_kw.update(psum_axis=DATA_AXIS,
+                               hist_agg=cfg.hist_agg,
+                               num_shards=self.grower.num_shards,
+                               voting_top_k=0)
+                gspecs = jax.tree_util.tree_map(
+                    lambda a: P(*([None] * (np.ndim(a) - 1)
+                                  + [DATA_AXIS])), gstate)
+                fn = _make_fused_step_sharded(
+                    self.objective.make_grad_fn(), grow_kw, lr,
+                    self.dtype, self.grower.mesh,
+                    len(self.valid_bins_dev), gspecs, reorder)
+            else:
+                make = (_make_fused_step_reorder if reorder
+                        else _make_fused_step)
+                fn = make(self.objective.make_grad_fn(), grow_kw, lr,
+                          self.dtype)
             _FUSED_STEPS[key] = fn
             if len(_FUSED_STEPS) > _FUSED_STEPS_MAX:
                 _FUSED_STEPS.popitem(last=False)
         else:
             _FUSED_STEPS.move_to_end(key)
-        gstate = (self._gstate_override if self._gstate_override is not None
-                  else self.objective.grad_state())
         if reorder:
             order = (self._row_order if self._row_order is not None
                      else jnp.arange(self.n_pad, dtype=jnp.int32))

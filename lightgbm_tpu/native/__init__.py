@@ -161,23 +161,27 @@ def _dbl_ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
 
-def parse_dense(text: bytes, sep: str) -> Optional[np.ndarray]:
+def parse_dense(text: bytes, sep: str,
+                cols: Optional[int] = None) -> Optional[np.ndarray]:
     """text -> [rows, cols] f64, or None when native is unavailable.
     Thread-parallel across row blocks (the reference parses with OpenMP
     the same way, dataset_loader.cpp:715-790).  Raises on malformed
-    tokens (reference Atof Log::Fatal, common.h:283-286)."""
+    tokens (reference Atof Log::Fatal, common.h:283-286).  `cols`
+    overrides the first-row schema width (prediction parses at the
+    MODEL's width, io/parser.parse_dense)."""
     lib = get_lib()
     if lib is None:
         return None
     rows = ctypes.c_int64()
-    cols = ctypes.c_int64()
+    sc_cols = ctypes.c_int64()
     lib.lgt_scan_dense(text, len(text), sep.encode()[0],
-                       ctypes.byref(rows), ctypes.byref(cols))
+                       ctypes.byref(rows), ctypes.byref(sc_cols))
+    ncol = cols if cols is not None else sc_cols.value
     if rows.value == 0:
-        return np.zeros((0, 0), dtype=np.float64)
-    out = np.empty((rows.value, cols.value), dtype=np.float64)
+        return np.zeros((0, ncol or 0), dtype=np.float64)
+    out = np.empty((rows.value, ncol), dtype=np.float64)
     got = lib.lgt_parse_dense_mt(text, len(text), sep.encode()[0],
-                                 _dbl_ptr(out), rows.value, cols.value,
+                                 _dbl_ptr(out), rows.value, ncol,
                                  default_threads())
     if got < 0:
         from ..utils import log
@@ -525,12 +529,14 @@ def predict_chunk(text: bytes, fmt: str, sep: str, label_idx: int,
     else:
         per_row = forest.num_class * 27 + 2
     # output sizing without a dedicated line-count pass (the kernel's own
-    # plan already counts rows): estimate rows from the first line's
-    # length, and if the guess undershoots (ragged line lengths) retry
-    # once with the exact count the kernel reported
-    first_nl = text.find(b"\n")
-    line_len = (first_nl + 1) if first_nl >= 0 else max(len(text), 1)
-    rows_est = len(text) // max(line_len, 1) + 8
+    # plan already counts rows): estimate rows from the average line
+    # length over the chunk's first 64 KB (a single blank/short first
+    # line must not inflate the estimate into a GB-scale allocation),
+    # and if the guess undershoots (ragged line lengths) retry once with
+    # the exact count the kernel reported
+    head = text[:65536]
+    avg_len = max(2, len(head) // max(head.count(b"\n"), 1))
+    rows_est = len(text) // avg_len + 16
     cap = int(rows_est * per_row * 9 // 8 + 16)
     seen = ctypes.c_int64()
     pi = ctypes.POINTER(ctypes.c_int64)

@@ -259,7 +259,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     # happens inside best_of); plain psum all-reduces the full tensor
     hist_psum = (lambda x: x) if (voting or scatter) else psum
 
-    ranged_on = (ranged and hist_impl == "pallas" and psum_axis is None
+    ranged_on = (ranged and hist_impl == "pallas"
                  and feature_axis is None)
     if ranged_on:
         # Block-list sweeps (VERDICT r2 #1): per split, sweep ONLY the
@@ -270,6 +270,10 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         # Pays off when rows are leaf-clustered (the ordered-partition
         # mode in models/gbdt.py re-sorts rows by the previous tree's
         # leaves every few trees); never sweeps more than the full grid.
+        # Under tree_learner=data (psum_axis set) everything here is
+        # shard-LOCAL — blocks, occupancy, block list, re-sorts — except
+        # the ladder-rung choice below and the histogram reduction the
+        # other impls share (hist_psum).
         from .hist_pallas import (PALLAS_ROW_BLOCK, fold_leaf_mask,
                                   leaf_histogram_blocklist, make_gh2)
         gh2 = make_gh2(grad, hess)
@@ -289,9 +293,15 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
             # complement keeps file order => full-sweep association)
             blist = jnp.argsort(jnp.where(occ, 0, 1).astype(jnp.int32),
                                 stable=True).astype(jnp.int32)
+            # SPMD-uniform rung (VERDICT r3 #2): the rung is picked from
+            # the MAX occupancy over shards so every shard dispatches the
+            # same compiled branch; each shard still sweeps only its OWN
+            # occupied blocks (blist / n_occ stay shard-local)
+            n_sel = (jax.lax.pmax(n_occ, psum_axis) if psum_axis
+                     else n_occ)
             sel = jnp.int32(len(ladder) - 1)
             for i in range(len(ladder) - 2, -1, -1):
-                sel = jnp.where(n_occ <= ladder[i], jnp.int32(i), sel)
+                sel = jnp.where(n_sel <= ladder[i], jnp.int32(i), sel)
 
             def mk(g):
                 def branch(le, bl, na):
@@ -300,8 +310,8 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                         grid_blocks=g, interpret=interpret).astype(dtype)
                 return branch
 
-            return jax.lax.switch(sel, [mk(g) for g in ladder],
-                                  leaf_eff, blist, n_occ)
+            return hist_psum(jax.lax.switch(sel, [mk(g) for g in ladder],
+                                            leaf_eff, blist, n_occ))
     elif hist_impl == "pallas":
         from .hist_pallas import (fold_leaf_mask, leaf_histogram_masked,
                                   make_gh2)

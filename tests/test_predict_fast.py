@@ -159,8 +159,15 @@ def _rows(n=400, f=4, seed=3):
         vals = ["%.6g" % v for v in x[i]]
         if i % 23 == 5:
             vals[1] = "na"          # -> 0.0 (Atof token rule)
-        if i % 37 == 11:
-            vals = vals[:2]         # ragged short row
+        if i == 0 or i % 37 == 11:
+            vals = vals[:2]         # ragged short row — INCLUDING row 0:
+            #                         prediction parses at the model's
+            #                         width, not the first row's
+        if i % 29 == 17:
+            vals = vals + ["7.5"]   # ragged wide row: the extra column
+            #                         maps past max_feature_idx and is
+            #                         dropped (predictor.hpp's
+            #                         p.first < num_features check)
         if i % 41 == 13:
             vals[0] = "4.9e-11"     # |v| <= 1e-10 dense drop rule
         rows.append(["%g" % (i % 2)] + vals)
