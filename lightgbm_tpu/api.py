@@ -461,6 +461,12 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     p = dict(params)
     if early_stopping_rounds is not None:
         p["early_stopping_round"] = early_stopping_rounds
+    # size per-iteration device state (e.g. the DART tree bank) for the
+    # actual round count; training is still driven by the loop below
+    if not any(k in p for k in ("num_iterations", "num_iteration",
+                                "num_tree", "num_trees", "num_round",
+                                "num_rounds")):
+        p["num_iterations"] = num_boost_round
     booster = Booster(p, train_set=train_set)
     names = list(valid_names or
                  ["valid_%d" % i for i in range(len(valid_sets))])

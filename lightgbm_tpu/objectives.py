@@ -205,9 +205,9 @@ class BinaryLogloss(Objective):
 
 class MulticlassSoftmax(Objective):
     name = "multiclass"
-    # NOTE: traceable math, but the fused step (gbdt._can_fuse) also
-    # requires num_class == 1 — multiclass gradients are [K, N] while the
-    # fused step feeds scores[0]; it always takes the general path
+    # [K, N] gradients feed the MULTICLASS fused step
+    # (gbdt._make_fused_step_multi): one dispatch grows all K
+    # per-iteration trees via a class-wise lax.scan
     jax_traceable = True
 
     def __init__(self, config: Config):
@@ -229,23 +229,37 @@ class MulticlassSoftmax(Objective):
         self.weights = self._pad(self.weights, n_pad)
 
     def get_gradients(self, score):
-        """score [K, N] -> grad/hess [K, N].
+        """score [K, N] -> grad/hess [K, N] (see make_grad_fn)."""
+        return self.make_grad_fn()(score, self.grad_state())
 
-        The softmax itself runs in float64 with the result cast to
-        float32, reproducing the reference's double-precision
-        Common::Softmax rec[] with score_t p = (float)rec[k]
-        (multiclass_objective.hpp:35-53, common.h:353-367) — under
-        default x64-disabled JAX the cast is a no-op and everything
-        stays f32."""
-        score = score.astype(jnp.float32)
-        p = jax.nn.softmax(score.astype(jnp.float64), axis=0) \
-            .astype(jnp.float32)
-        grad = p - self.onehot
-        hess = 2.0 * p * (1.0 - p)
-        if self.weights is not None:
-            grad = grad * self.weights[None, :]
-            hess = hess * self.weights[None, :]
-        return grad, hess
+    def fused_key(self):
+        return ("multiclass", self.num_class, self.weights is not None)
+
+    def grad_state(self):
+        return (self.onehot, self.weights)
+
+    @staticmethod
+    def make_grad_fn():
+        def grad_fn(score, state):
+            """score [K, N] -> grad/hess [K, N].
+
+            The softmax itself runs in float64 with the result cast to
+            float32, reproducing the reference's double-precision
+            Common::Softmax rec[] with score_t p = (float)rec[k]
+            (multiclass_objective.hpp:35-53, common.h:353-367) — under
+            default x64-disabled JAX the cast is a no-op and everything
+            stays f32."""
+            onehot, weights = state
+            score = score.astype(jnp.float32)
+            p = jax.nn.softmax(score.astype(jnp.float64), axis=0) \
+                .astype(jnp.float32)
+            grad = p - onehot
+            hess = 2.0 * p * (1.0 - p)
+            if weights is not None:
+                grad = grad * weights[None, :]
+                hess = hess * weights[None, :]
+            return grad, hess
+        return grad_fn
 
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         e = np.exp(score - score.max(axis=0, keepdims=True))

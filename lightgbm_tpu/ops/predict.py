@@ -29,19 +29,27 @@ def predict_leaf_binned(split_feature: jax.Array, threshold_bin: jax.Array,
     """
     n = bins_t.shape[1]
     node = jnp.zeros(n, dtype=jnp.int32)
+    # a well-formed tree reaches its leaf in < num_nodes steps; the bound
+    # makes degenerate inputs (an unsplit stump's all-zero child arrays,
+    # e.g. an untouched DART-bank row) terminate at node 0 -> ~0 = -1,
+    # which gathers the zero-valued dummy leaf slot instead of spinning
+    # the while_loop forever
+    max_steps = split_feature.shape[0] + 1
 
-    def cond(node):
-        return jnp.any(node >= 0)
+    def cond(carry):
+        i, node = carry
+        return (i < max_steps) & jnp.any(node >= 0)
 
-    def body(node):
+    def body(carry):
+        i, node = carry
         idx = jnp.maximum(node, 0)
         feat = split_feature[idx]
         thr = threshold_bin[idx]
         val = bins_t[feat, jnp.arange(n)].astype(jnp.int32)
         nxt = jnp.where(val <= thr, left_child[idx], right_child[idx])
-        return jnp.where(node >= 0, nxt, node)
+        return i + 1, jnp.where(node >= 0, nxt, node)
 
-    node = jax.lax.while_loop(cond, body, node)
+    _, node = jax.lax.while_loop(cond, body, (jnp.int32(0), node))
     return ~node
 
 

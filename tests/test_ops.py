@@ -545,3 +545,57 @@ def test_ordered_mode_bagged_matches_default():
                                       t2.split_feature_real)
         np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
         np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
+
+
+def test_dart_banked_matches_host_path_long_drops():
+    """The banked DART path must track the host-tree path through long
+    drop histories at f32: tree STRUCTURE stays identical, and model
+    leaf values replay the recorded drop-factor chain in f64
+    (DART._materialize_bank) — bit-identical to the host path's
+    numpy-f64 tree.shrinkage sequence wherever the as-trained values
+    agree (early trees match exactly; later trees carry the usual f32
+    score-rounding divergence between the two paths, bounded here)."""
+    import lightgbm_tpu as lgb
+    n = 2000
+    rng = np.random.RandomState(11)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    common = {"objective": "binary", "boosting_type": "dart",
+              "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 20,
+              "drop_rate": 0.3, "metric": ""}
+    b_bank = lgb.train(common, lgb.Dataset(x, label=y),
+                       num_boost_round=30, verbose_eval=False)
+    gb = b_bank._gbdt
+    assert gb._bank is not None            # the banked path actually ran
+
+    # host path: same binned dataset, bank disabled up front
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import DART
+    from lightgbm_tpu.objectives import create_objective
+    cfg = Config.from_params({str(k): str(v) for k, v in common.items()})
+    cfg.num_iterations = 30
+    ds_inner = lgb.Dataset(x, label=y).inner
+    obj = create_objective(cfg)
+    obj.init(ds_inner.metadata, ds_inner.num_data)
+    host = DART(cfg, ds_inner, obj)
+    host._bank_disabled = True             # force the host-tree path
+    host._flush_every = 1
+    for _ in range(30):
+        host.train_one_iter(None, None, False)
+    assert host._bank is None
+
+    mb, mh = gb.models, host.models
+    assert len(mb) == len(mh) == 30
+    exact = 0
+    for tb, th in zip(mb, mh):
+        np.testing.assert_array_equal(tb.split_feature_real,
+                                      th.split_feature_real)
+        np.testing.assert_array_equal(tb.threshold_bin, th.threshold_bin)
+        np.testing.assert_allclose(tb.leaf_value, th.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+        exact += int(np.array_equal(tb.leaf_value, th.leaf_value))
+    # the f64 replay is bit-exact while the two paths' f32 scores still
+    # agree — several heavily-dropped early trees must match to the bit
+    # (the device-dtype compounding this guards against drifted ~1e-4
+    # relative on EVERY dropped tree)
+    assert exact >= 5, exact
