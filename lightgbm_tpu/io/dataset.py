@@ -246,6 +246,23 @@ def _scan_libsvm_max_idx(chunk: bytes) -> int:
     return mx
 
 
+def _check_lottery_query_counts(qcounts: np.ndarray, filename: str) -> None:
+    """Zero-size queries are unsupported under distributed lottery
+    loading.  The reference's filter draws at boundary CROSSINGS (one
+    per line, dataset_loader.cpp:496-511), so an empty query's draw
+    lands on the NEXT query's first row, splitting that query across
+    ranks — and Metadata::CheckOrPartition then fatals with "Data
+    partition error" (metadata.cpp:154-165).  There is no trainable
+    reference behavior to replay; fail here with a clearer message."""
+    if (qcounts <= 0).any():
+        q = int(np.argmax(qcounts <= 0))
+        log.fatal("Query %d of %s.query has %d rows: zero-size queries "
+                  "break the reference's RNG row partition (its metadata "
+                  "partition fatals on the resulting split queries); "
+                  "remove them or use pre-partitioned files"
+                  % (q, filename, int(qcounts[q])))
+
+
 def _load_two_round(filename: str, config: Config, rank: int,
                     num_shards: int) -> Dataset:
     """use_two_round_loading: stream the file twice instead of holding the
@@ -256,29 +273,27 @@ def _load_two_round(filename: str, config: Config, rank: int,
     SampleFromFile).  The structural template for out-of-core-scale
     ingest: peak memory is one chunk of floats + the binned matrix.
 
-    Row sharding is modulo, or query-granular when a .query sidecar is
-    present (whole queries stay on one rank, like one-round loading);
-    ranking data declared via group_column still needs one-round loading
-    (the query ids would have to be parsed during round 1's raw-line
-    scan)."""
+    Row sharding replays the reference's seeded row lottery (one
+    NextInt(0, num_machines) draw per row, or per query when a .query
+    sidecar is present, interleaved with the reservoir draws on the
+    SAME stream — dataset_loader.cpp:538-569 via TextReader::
+    SampleAndFilterFromFile), so each rank keeps exactly the rows a
+    reference cluster would; ranking data declared via group_column
+    still needs one-round loading (the query ids would have to be
+    parsed during round 1's raw-line scan)."""
     sample_target = max(1, config.bin_construct_sample_cnt)
     sharding = num_shards > 1 and not config.is_pre_partition
 
-    # query-granular sharding from the .query sidecar: global row ->
-    # owning rank via the query index (reference partitions query-
-    # granularly, dataset_loader.cpp:467-572)
+    # query-granular sharding from the .query sidecar: one lottery draw
+    # per query, all its rows follow (reference partitions query-
+    # granularly, dataset_loader.cpp:549-569)
     qcounts_all = qb_global = None
     if sharding:
         qraw = _load_sidecar(filename + ".query")
         if qraw is not None:
             qcounts_all = qraw.astype(np.int64)
+            _check_lottery_query_counts(qcounts_all, filename)
             qb_global = np.concatenate([[0], np.cumsum(qcounts_all)])
-
-    def shard_sel(gidx: np.ndarray) -> np.ndarray:
-        if qb_global is not None:
-            qi = np.searchsorted(qb_global, gidx, side="right") - 1
-            return (qi % num_shards) == rank
-        return (gidx % num_shards) == rank
 
     # ---- round 1: count rows, reservoir-sample lines ----
     # The reference's streaming reservoir, replayed bit-exactly
@@ -287,11 +302,17 @@ def _load_two_round(filename: str, config: Config, rank: int,
     # the first S lines fill the reservoir; line i >= S draws
     # idx = NextInt(0, i+1) on the seeded mt19937 and replaces slot idx
     # when idx < S — so two-round bin boundaries (and therefore models)
-    # match the reference byte-for-byte.  When sharding, local rows are
-    # selected modulo first (documented divergence from the reference's
-    # RNG-based row partition, PARITY.md) and the replica stream draws
-    # only for local rows.
+    # match the reference byte-for-byte.  When sharding, the row lottery
+    # and the reservoir interleave on one stream via ShardLottery
+    # (keep masks are recorded per chunk — the analog of the reference's
+    # used_data_indices — and re-applied in round 2).
     res_rng = Mt19937Random(config.data_random_seed)
+    lottery = keep_chunks = None
+    if sharding:
+        from .. import native
+        lottery = native.ShardLottery(config.data_random_seed, num_shards,
+                                      rank, sample_target)
+        keep_chunks = []
     kept: List[bytes] = []
     n_sampled_seen = 0   # lines eligible for sampling (local rows)
     n_total = 0
@@ -318,17 +339,29 @@ def _load_two_round(filename: str, config: Config, rank: int,
                 libsvm_max_idx = max(libsvm_max_idx,
                                      _scan_libsvm_max_idx(chunk))
             if sharding:
-                # sample only THIS rank's rows, like one-round loading
-                # (shard first, then draw the bin sample from local rows)
-                gidx = np.arange(n_total, n_total + k)
+                # interleaved lottery + reservoir on ONE stream: each
+                # row (or query head) draws its owning rank; kept rows
+                # fill/replace reservoir slots (SampleAndFilterFromFile)
+                nu = None
+                if qb_global is not None:
+                    heads = qb_global[:-1]
+                    lo = np.searchsorted(heads, n_total)
+                    hi = np.searchsorted(heads, n_total + k)
+                    nu = np.zeros(k, dtype=np.uint8)
+                    nu[(heads[lo:hi] - n_total).astype(np.int64)] = 1
+                keep, slot = lottery.chunk(k, nu)
+                keep_chunks.append(keep)
                 n_total += k
-                sel = shard_sel(gidx)
-                starts, lens = starts[sel], lens[sel]
-                k = len(starts)
-                if k == 0:
-                    continue
-            else:
-                n_total += k
+                for t in np.flatnonzero(slot >= 0):
+                    a = int(starts[t])
+                    ln = bytes(chunk[a:a + int(lens[t])])
+                    s = int(slot[t])
+                    if s == len(kept):   # fill slots arrive in order
+                        kept.append(ln)
+                    else:
+                        kept[s] = ln
+                continue
+            n_total += k
             i0 = n_sampled_seen
             n_sampled_seen += k
             fill = max(0, min(sample_target - i0, k))
@@ -344,6 +377,21 @@ def _load_two_round(filename: str, config: Config, rank: int,
                         chunk[a:a + int(lens[fill + t])])
     if n_total == 0:
         log.fatal("Data file %s is empty" % filename)
+    keep_mask = None
+    if sharding:
+        # the recorded lottery outcome — the analog of the reference's
+        # used_data_indices (one bool per global row; round 2 and the
+        # sidecar partition re-apply it)
+        keep_mask = np.concatenate(keep_chunks) if keep_chunks \
+            else np.zeros(0, dtype=bool)
+        if qb_global is not None and int(qb_global[-1]) != n_total:
+            log.fatal("Query sizes (%d) do not sum to data count (%d)"
+                      % (int(qb_global[-1]), n_total))
+        if not keep_mask.any():
+            log.fatal("Rank %d's row-lottery shard of %s is empty "
+                      "(%d rows over %d machines); use fewer machines "
+                      "or pre-partitioned files"
+                      % (rank, filename, n_total, num_shards))
 
     label_idx = _parse_column_spec(config.label_column, names)
     if label_idx < 0:
@@ -412,15 +460,11 @@ def _load_two_round(filename: str, config: Config, rank: int,
     # ---- round 2: parse + quantize chunk by chunk ----
     if not sharding:
         n_local = n_total
-    elif qb_global is not None:
-        if int(qb_global[-1]) != n_total:
-            log.fatal("Query sizes (%d) do not sum to data count (%d)"
-                      % (int(qb_global[-1]), n_total))
-        qsel_mask = (np.arange(len(qcounts_all)) % num_shards) == rank
-        n_local = int(qcounts_all[qsel_mask].sum())
     else:
-        n_local = (n_total // num_shards
-                   + (1 if rank < n_total % num_shards else 0))
+        n_local = int(np.count_nonzero(keep_mask))
+        if qb_global is not None:
+            # per-query lottery outcome = the mask at each query head
+            qsel_mask = keep_mask[qb_global[:-1].astype(np.int64)]
     max_bin_used = max(m.num_bin for m in bin_mappers)
     dtype = np.uint8 if max_bin_used <= 256 else np.uint16
     bins = np.zeros((len(bin_mappers), n_local), dtype=dtype)
@@ -473,7 +517,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
                 keep = None
                 if sharding:
                     k = native.count_lines(chunk)
-                    keep = shard_sel(np.arange(row0, row0 + k))
+                    keep = keep_mask[row0:row0 + k]
                 if fused == "dense":
                     kk, k = native.parse_bin_dense_chunk(
                         chunk, "\t" if fmt == "tsv" else ",", nfile,
@@ -503,7 +547,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
             elif cfeats.shape[1] > ncols:
                 cfeats = cfeats[:, :ncols]
             if sharding:
-                sel = shard_sel(np.arange(row0, row0 + k))
+                sel = keep_mask[row0:row0 + k]
                 clabel, cfeats = clabel[sel], cfeats[sel]
             kk = len(clabel)
             label[out0:out0 + kk] = clabel
@@ -545,10 +589,7 @@ def _load_two_round(filename: str, config: Config, rank: int,
     init = _load_sidecar(filename + ".init")
     local_rows = None
     if sharding:
-        if qb_global is not None:
-            keep = np.repeat(qsel_mask, qcounts_all)
-        else:
-            keep = np.arange(n_total) % num_shards == rank
+        keep = keep_mask
         local_rows = np.nonzero(keep)[0].astype(np.int64)
         if w is not None:
             weights = weights[keep]
@@ -585,9 +626,11 @@ def load_dataset(filename: str, config: Config,
     """Load a text data file into a binned Dataset.
 
     reference: train Dataset whose bin mappers must be reused (valid data).
-    rank/num_shards: row sharding for distributed loading — each host keeps
-    rows r with r % num_shards == rank (reference dataset_loader.cpp:467-512
-    uses random assignment; modulo keeps determinism without an RNG sync).
+    rank/num_shards: row sharding for distributed loading — unless
+    is_pre_partition, each host keeps the rows the reference's seeded
+    row lottery assigns it (one NextInt(0, num_machines) draw per row,
+    or per query; dataset_loader.cpp:467-512).  Every rank replays the
+    identical stream, so the partition needs no communication.
     """
     cache = filename + ".bin"
     if (reference is None and config.enable_load_from_binary_file
@@ -694,21 +737,36 @@ def load_dataset(filename: str, config: Config,
         log.info("Loading query boundaries...")
     init = _load_sidecar(filename + ".init")
 
-    # distributed row sharding: whole queries go to one rank when query
-    # info exists (the reference partitions query-granularly,
-    # dataset_loader.cpp:467-572); labels, features and ALL metadata
-    # shard with the same mask (Metadata::CheckOrPartition)
+    # distributed row sharding: the reference's seeded row lottery (one
+    # NextInt(0, num_machines) draw per row — or per query, whole
+    # queries stay on one rank — on Random(data_random_seed); every
+    # rank replays the same stream, so the partition needs no
+    # communication.  Reference dataset_loader.cpp:467-512 via
+    # TextReader::ReadAndFilterLines; labels, features and ALL metadata
+    # shard with the same mask (Metadata::CheckOrPartition).  The SAME
+    # stream then continues into the bin-sample draws below
+    # (DatasetLoader keeps one random_ member for both).
     local_rows = None
+    shard_lottery = None
     if num_shards > 1 and not config.is_pre_partition:
+        from .. import native
+        shard_lottery = native.ShardLottery(
+            config.data_random_seed, num_shards, rank, -1)
         if query_boundaries is not None:
             nq = len(query_boundaries) - 1
-            qsel = np.arange(nq) % num_shards == rank
             qcounts = np.diff(query_boundaries)
+            _check_lottery_query_counts(qcounts, filename)
+            qsel, _ = shard_lottery.chunk(nq)
             keep = np.repeat(qsel, qcounts)
             query_boundaries = np.concatenate(
                 [[0], np.cumsum(qcounts[qsel])]).astype(np.int32)
         else:
-            keep = np.arange(n_total) % num_shards == rank
+            keep, _ = shard_lottery.chunk(n_total)
+        if not keep.any():
+            log.fatal("Rank %d's row-lottery shard of %s is empty "
+                      "(%d rows over %d machines); use fewer machines "
+                      "or pre-partitioned files"
+                      % (rank, filename, n_total, num_shards))
         local_rows = np.nonzero(keep)[0].astype(np.int64)
         label, feats = label[keep], feats[keep]
         if weights is not None:
@@ -749,12 +807,17 @@ def load_dataset(filename: str, config: Config,
     # ---- find bins on a sample (bin_construct_sample_cnt rows) ----
     sample_cnt = min(config.bin_construct_sample_cnt, n)
     if sample_cnt < n:
-        # Random::Sample on the seeded mt19937 replica — the reference's
+        # Random::Sample on the seeded mt19937 — the reference's
         # one-round sample (DatasetLoader::SampleTextDataFromMemory,
         # dataset_loader.cpp:514-526), so sub-sampled bin boundaries
-        # match the reference bit-for-bit
-        sample_idx = Mt19937Random(config.data_random_seed).sample(
-            n, sample_cnt)
+        # match the reference bit-for-bit.  Under the row lottery the
+        # sample continues the lottery's stream (same random_ member);
+        # single-machine it starts fresh at data_random_seed.
+        if shard_lottery is not None:
+            sample_idx = shard_lottery.sample(n, sample_cnt)
+        else:
+            sample_idx = Mt19937Random(config.data_random_seed).sample(
+                n, sample_cnt)
         sample = feats[sample_idx]
     else:
         sample = feats

@@ -141,6 +141,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, i64, i64, pi32, pd, pi32, pi32, pd, pi64, pi64,
         i64, i64, ctypes.c_double, i32, ctypes.c_char_p, i64, i32, pi64]
     lib.lgt_predict_libsvm_mt.restype = i64
+    lib.lgt_lottery_new.argtypes = [i32, i64, i64, i64]
+    lib.lgt_lottery_new.restype = ctypes.c_void_p
+    lib.lgt_lottery_free.argtypes = [ctypes.c_void_p]
+    lib.lgt_lottery_free.restype = None
+    lib.lgt_lottery_chunk.argtypes = [ctypes.c_void_p, i64, pu8, pu8, pi64]
+    lib.lgt_lottery_chunk.restype = None
+    lib.lgt_lottery_doubles.argtypes = [ctypes.c_void_p, i64, pd]
+    lib.lgt_lottery_doubles.restype = None
     _lib = lib
     return _lib
 
@@ -449,6 +457,133 @@ def selection_mask(draws: np.ndarray, k: int) -> Optional[np.ndarray]:
                            mask.ctypes.data_as(
                                ctypes.POINTER(ctypes.c_uint8)))
     return mask.astype(bool)
+
+
+def selection_walk(draws: np.ndarray, k: int) -> np.ndarray:
+    """Selection-sampling acceptance mask over a pre-drawn NextDouble
+    stream (reference Random::Sample, random.h:55-67: accept i when
+    draw_i < (k - taken)/(n - i)) — the native kernel when available,
+    else the identical IEEE walk in Python.  The single home of this
+    loop; Mt19937Random and ShardLottery both replay through it."""
+    mask = selection_mask(draws, k)
+    if mask is not None:
+        return mask
+    n = len(draws)
+    mask = np.zeros(n, dtype=bool)
+    taken = 0
+    for i in range(n):
+        if draws[i] < (k - taken) / (n - i):
+            mask[i] = True
+            taken += 1
+    return mask
+
+
+class ShardLottery:
+    """Stateful replay of the reference's multi-machine row lottery and
+    (two-round) bin-sample reservoir: one seeded-mt19937
+    NextInt(0, num_machines) draw per row or query decides the owning
+    rank, and locally-kept rows feed the streaming reservoir with
+    NextInt(0, local_count) draws on the SAME stream (reference
+    DatasetLoader::LoadTextDataToMemory / SampleTextDataFromFile,
+    src/io/dataset_loader.cpp:467-572 + text_reader.h:174-211).
+
+    Uses the native lgt_lottery kernel (built by the same libstdc++ as
+    the reference binary — identical downscaling/rejection behavior)
+    when available, else a scalar walk on the Mt19937Random replica.
+
+    sample_cnt < 0 disables the reservoir (the one-round path's
+    ReadAndFilterLines draws the lottery only; Random::Sample then
+    continues the stream via doubles()).
+    """
+
+    def __init__(self, seed: int, num_machines: int, rank: int,
+                 sample_cnt: int):
+        self._m = int(num_machines)
+        self._rank = int(rank)
+        self._sample_cnt = int(sample_cnt)
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._h = self._lib.lgt_lottery_new(
+                int(seed), self._m, self._rank, self._sample_cnt)
+        else:
+            from ..utils.mt19937 import Mt19937Random
+            self._rng = Mt19937Random(seed)
+            self._local_cnt = 0
+            self._filled = 0
+            self._keep_cur = False
+
+    def chunk(self, k: int, new_unit: Optional[np.ndarray] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Advance over k rows; new_unit[i] truthy starts a new lottery
+        unit (None: every row draws).  Returns (keep bool [k],
+        reservoir slot int64 [k], -1 = none); fill slots arrive in
+        order, so `append if slot == len(kept) else replace` rebuilds
+        the reservoir exactly."""
+        k = int(k)
+        if self._lib is not None:
+            keep = np.empty(k, dtype=np.uint8)
+            slot = np.empty(k, dtype=np.int64)
+            nu = None
+            if new_unit is not None:
+                nu = np.ascontiguousarray(new_unit, dtype=np.uint8)
+                nu = nu.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            self._lib.lgt_lottery_chunk(
+                self._h, k, nu,
+                keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            return keep.astype(bool), slot
+        keep = np.zeros(k, dtype=bool)
+        slot = np.full(k, -1, dtype=np.int64)
+        if self._sample_cnt < 0 and new_unit is None:
+            # lottery-only row mode: no reservoir draws interleave, so
+            # the whole chunk batches into one vectorized replay
+            draws = self._rng.next_ints(np.full(k, self._m, dtype=np.int64))
+            keep = draws == self._rank
+            self._local_cnt += int(np.count_nonzero(keep))
+            if k:
+                self._keep_cur = bool(keep[-1])
+            return keep, slot
+        for i in range(k):
+            if new_unit is None or new_unit[i]:
+                draw = int(self._rng.next_ints([self._m])[0])
+                self._keep_cur = draw == self._rank
+            keep[i] = self._keep_cur
+            if not self._keep_cur:
+                continue
+            self._local_cnt += 1
+            if self._sample_cnt < 0:
+                continue
+            if self._filled < self._sample_cnt:
+                slot[i] = self._filled
+                self._filled += 1
+            else:
+                idx = int(self._rng.next_ints([self._local_cnt])[0])
+                if idx < self._sample_cnt:
+                    slot[i] = idx
+        return keep, slot
+
+    def doubles(self, n: int) -> np.ndarray:
+        """n NextDouble draws continuing the same stream (the one-round
+        Random::Sample replay, dataset_loader.cpp:514-526)."""
+        n = int(n)
+        if self._lib is not None:
+            out = np.empty(n, dtype=np.float64)
+            self._lib.lgt_lottery_doubles(self._h, n, _dbl_ptr(out))
+            return out
+        return self._rng.next_doubles(n)
+
+    def sample(self, n: int, k: int) -> np.ndarray:
+        """Random::Sample(n, k) on the continued stream (random.h:55-67):
+        consumes exactly n NextDouble draws."""
+        if k > n or k < 0:
+            return np.zeros(0, dtype=np.int32)
+        mask = selection_walk(self.doubles(n), k)
+        return np.flatnonzero(mask).astype(np.int32)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            lib.lgt_lottery_free(self._h)
 
 
 def sort_importance(counts: np.ndarray) -> Optional[np.ndarray]:

@@ -26,6 +26,7 @@
 #include <cstring>
 #include <limits>
 #include <locale.h>
+#include <random>
 #include <string>
 #include <thread>
 #include <vector>
@@ -943,6 +944,84 @@ void lgt_selection_mask(const double* draws, int64_t n, int64_t k,
       mask[i] = 0;
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// Multi-machine row lottery + bin-sample reservoir.
+//
+// The reference partitions a NON-pre-partitioned data file across
+// machines by a seeded RNG lottery: one NextInt(0, num_machines) draw
+// per row (or per query when a .query sidecar exists) decides the
+// owning rank, and — under two-round loading — locally-kept rows then
+// feed the streaming bin-sample reservoir with NextInt(0, local_count)
+// draws on the SAME mt19937 (DatasetLoader::LoadTextDataToMemory /
+// SampleTextDataFromFile, src/io/dataset_loader.cpp:467-572, via
+// TextReader::ReadAndFilterLines / SampleAndFilterFromFile,
+// include/LightGBM/utils/text_reader.h:174-211; the RNG is
+// Random(io_config.data_random_seed), include/LightGBM/utils/random.h).
+//
+// This kernel is that interleaved draw stream as a stateful handle fed
+// chunk by chunk.  It is compiled by the same g++/libstdc++ that builds
+// the reference binary here, so uniform_int_distribution's downscaling
+// and rejection behavior match by construction — every rank replays the
+// identical stream (the seed is config-synced), so the partition needs
+// no communication.
+struct LgtLottery {
+  std::mt19937 gen;
+  int64_t num_machines, rank, sample_cnt;
+  int64_t local_cnt = 0;  // locally-kept rows so far (reservoir ub)
+  int64_t filled = 0;     // reservoir slots filled so far
+  uint8_t keep_cur = 0;   // current unit's lottery outcome (chunk carry)
+  LgtLottery(int32_t seed, int64_t m, int64_t r, int64_t s)
+      : gen(static_cast<std::mt19937::result_type>(seed)),
+        num_machines(m), rank(r), sample_cnt(s) {}
+  int64_t next_int(int64_t ub) {  // Random::NextInt(0, ub), random.h:30-40
+    std::uniform_int_distribution<int64_t> d(0, ub - 1);
+    return d(gen);
+  }
+};
+
+void* lgt_lottery_new(int32_t seed, int64_t num_machines, int64_t rank,
+                      int64_t sample_cnt) {
+  return new LgtLottery(seed, num_machines, rank, sample_cnt);
+}
+
+void lgt_lottery_free(void* h) { delete static_cast<LgtLottery*>(h); }
+
+// k rows of one chunk.  new_unit[i] != 0 starts a new lottery unit
+// (row granularity: NULL = every row; query granularity: 1 at each
+// query head, with keep_cur carrying the open query's outcome across
+// chunk boundaries).  keep[i]: row kept on this rank.  slot[i]: the
+// reservoir slot this row's line writes (fill slots arrive in order;
+// replacement slots are < sample_cnt), or -1.  sample_cnt < 0 disables
+// the reservoir entirely (one-round ReadAndFilterLines: lottery only).
+void lgt_lottery_chunk(void* h, int64_t k, const uint8_t* new_unit,
+                       uint8_t* keep, int64_t* slot) {
+  auto* st = static_cast<LgtLottery*>(h);
+  for (int64_t i = 0; i < k; ++i) {
+    if (!new_unit || new_unit[i])
+      st->keep_cur = st->next_int(st->num_machines) == st->rank ? 1 : 0;
+    keep[i] = st->keep_cur;
+    if (slot) slot[i] = -1;
+    if (!st->keep_cur) continue;
+    ++st->local_cnt;
+    if (st->sample_cnt < 0 || !slot) continue;
+    if (st->filled < st->sample_cnt) {
+      slot[i] = st->filled++;
+    } else {
+      int64_t idx = st->next_int(st->local_cnt);
+      if (idx < st->sample_cnt) slot[i] = idx;
+    }
+  }
+}
+
+// n NextDouble draws continuing the same stream: the one-round path's
+// Random::Sample replay consumes these after the lottery
+// (SampleTextDataFromMemory, dataset_loader.cpp:514-526).
+void lgt_lottery_doubles(void* h, int64_t n, double* out) {
+  auto* st = static_cast<LgtLottery*>(h);
+  std::uniform_real_distribution<double> d(0.0, 1.0);
+  for (int64_t i = 0; i < n; ++i) out[i] = d(st->gen);
 }
 
 // Bulk "%g" score formatting for task=predict output

@@ -173,18 +173,8 @@ class Mt19937Random:
         (lgt_selection_mask — the exact IEEE ops of the reference loop);
         the Python walk is the no-toolchain fallback.
         """
-        draws = self.next_doubles(n)
         from .. import native
-        mask = native.selection_mask(draws, k)
-        if mask is not None:
-            return mask
-        mask = np.zeros(n, dtype=bool)
-        taken = 0
-        for i in range(n):
-            if draws[i] < (k - taken) / (n - i):
-                mask[i] = True
-                taken += 1
-        return mask
+        return native.selection_walk(self.next_doubles(n), k)
 
     def sample(self, n: int, k: int) -> np.ndarray:
         """Sequential selection sampling; reference random.h:55-67.

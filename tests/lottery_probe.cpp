@@ -1,0 +1,116 @@
+// GOLDEN-CAPTURE TEST INFRASTRUCTURE — not framework code.
+//
+// Drives the REFERENCE's own header-only TextReader/Random
+// (-I/root/reference/include) through the exact call pattern of
+// DatasetLoader::LoadTextDataToMemory / SampleTextDataFromFile
+// (src/io/dataset_loader.cpp:467-572) and prints the resulting
+// per-rank row sets and bin-sample reservoir, so the framework's
+// ShardLottery replay can be asserted against the reference's real
+// draw stream (same role as the .ref_build reference binary used for
+// model goldens).  Compiled on demand by
+// tests/test_parallel.py::test_lottery_* with the system g++.
+//
+// Usage:
+//   lottery_probe tworound <file> <seed> <M> <rank> <cnt> [queryfile]
+//   lottery_probe oneround <file> <seed> <M> <rank> <cnt> [queryfile]
+//
+// Output: "total=<N>" line, "used:" line of kept global row indices,
+// then for tworound "sample:" lines with the reservoir contents
+// (base64-free raw lines, one per "s=" prefix), for oneround
+// "sample_idx:" line with Random::Sample indices into the kept rows.
+
+#include <LightGBM/utils/random.h>
+#include <LightGBM/utils/text_reader.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+using LightGBM::Random;
+using LightGBM::TextReader;
+
+static std::vector<int> load_query_boundaries(const char* path) {
+  // query sidecar = per-query counts, one per line -> boundaries
+  std::vector<int> b(1, 0);
+  std::ifstream f(path);
+  long v;
+  while (f >> v) b.push_back(b.back() + static_cast<int>(v));
+  return b;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    std::fprintf(stderr, "args\n");
+    return 2;
+  }
+  const bool two_round = std::strcmp(argv[1], "tworound") == 0;
+  const char* file = argv[2];
+  const int seed = std::atoi(argv[3]);
+  const int num_machines = std::atoi(argv[4]);
+  const int rank = std::atoi(argv[5]);
+  const int sample_cnt = std::atoi(argv[6]);
+  std::vector<int> qb;
+  if (argc > 7) qb = load_query_boundaries(argv[7]);
+
+  Random random(seed);
+  TextReader<int> reader(file, false);
+  std::vector<int> used;
+  std::vector<std::string> sampled;
+  int num_global = 0;
+
+  // the filter lambdas below mirror dataset_loader.cpp:476-511 (one
+  // round) and :538-569 (two round) — row lottery, or query lottery
+  // carried across the query's rows
+  int qid = -1;
+  bool is_query_used = false;
+  auto row_filter = [&](int) {
+    return random.NextInt(0, num_machines) == rank;
+  };
+  auto query_filter = [&](int line_idx) {
+    if (line_idx >= qb[qid + 1]) {
+      is_query_used = false;
+      if (random.NextInt(0, num_machines) == rank) is_query_used = true;
+      ++qid;
+    }
+    return is_query_used;
+  };
+
+  if (two_round) {
+    if (qb.empty()) {
+      num_global = reader.SampleAndFilterFromFile(row_filter, &used, random,
+                                                  sample_cnt, &sampled);
+    } else {
+      num_global = reader.SampleAndFilterFromFile(query_filter, &used, random,
+                                                  sample_cnt, &sampled);
+    }
+  } else {
+    if (qb.empty()) {
+      num_global = reader.ReadAndFilterLines(row_filter, &used);
+    } else {
+      num_global = reader.ReadAndFilterLines(query_filter, &used);
+    }
+  }
+
+  std::printf("total=%d\n", num_global);
+  std::printf("used:");
+  for (int i : used) std::printf(" %d", i);
+  std::printf("\n");
+  if (two_round) {
+    for (const auto& s : sampled) std::printf("s=%s\n", s.c_str());
+  } else {
+    // SampleTextDataFromMemory (dataset_loader.cpp:514-526): clamp to
+    // the LOCAL line count, Random::Sample on the continued stream
+    int n_local = static_cast<int>(
+        used.empty() && num_machines == 1 ? num_global : used.size());
+    int cnt = sample_cnt;
+    if (cnt > n_local) cnt = n_local;
+    auto idx = random.Sample(n_local, cnt);
+    std::printf("sample_idx:");
+    for (int i : idx) std::printf(" %d", i);
+    std::printf("\n");
+  }
+  return 0;
+}

@@ -1,14 +1,27 @@
-"""Worker for the distributed golden-parity test
-(test_parallel.py::test_multihost_matches_reference_socket_cluster).
+"""Worker for the distributed golden-parity tests
+(test_parallel.py::test_multihost_matches_reference_socket_cluster and
+::test_multihost_lottery_matches_reference_socket_cluster).
 
 Mirrors ONE machine of the reference's 2-machine socket data-parallel
-run (examples/parallel_learning with tree_learner=data,
-is_pre_partition pre-split): loads its modulo row shard of binary.train,
-runs distributed bin finding over the 2-process allgather, trains with
-the bagging/feature_fraction RNG streams, prints metric lines in the
-reference log format, and saves the model.
+run (tree_learner=data, distributed bin finding, bagging/
+feature_fraction RNG streams), prints metric lines in the reference
+log format, and saves the model.  Three data modes:
 
-Usage: python mh_parity_worker.py <rank> <nproc> <port> <out_model> <out_log>
+- presplit: the examples/parallel_learning scenario — writes its
+  modulo row shard of binary.train to a rank file and loads it with
+  is_pre_partition=true, exactly how the golden's reference cluster
+  consumed pre-split halves.
+- lottery: the shared binary.train with is_pre_partition=false — the
+  loader replays the reference's seeded row lottery
+  (dataset_loader.cpp:467-512) to pick this rank's rows.
+- lottery2r: same, plus use_two_round_loading=true with
+  bin_construct_sample_cnt=2000 — small enough that the bin-sample
+  reservoir draws interleave into the lottery stream
+  (SampleAndFilterFromFile) and the reference's per-rank streams
+  desync; the golden cluster ran in exactly that regime.
+
+Usage: python mh_parity_worker.py <rank> <nproc> <port> <out_model>
+       <out_log> [presplit|lottery|lottery2r]
 """
 
 import os
@@ -17,6 +30,7 @@ import sys
 rank, nproc, port, out_model, out_log = (int(sys.argv[1]), int(sys.argv[2]),
                                          sys.argv[3], sys.argv[4],
                                          sys.argv[5])
+mode = sys.argv[6] if len(sys.argv) > 6 else "presplit"
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
@@ -39,7 +53,7 @@ from lightgbm_tpu.objectives import create_objective  # noqa: E402
 EX = os.environ.get("LGT_REFERENCE_DIR",
                     "/root/reference") + "/examples/binary_classification"
 ITERS = 4
-cfg = Config.from_params({
+params = {
     "objective": "binary", "tree_learner": "data",
     "metric": "binary_logloss,auc", "is_training_metric": "true",
     "max_bin": "255", "num_leaves": "63", "learning_rate": "0.1",
@@ -47,9 +61,28 @@ cfg = Config.from_params({
     "bagging_fraction": "0.8", "min_data_in_leaf": "50",
     "min_sum_hessian_in_leaf": "5.0", "hist_dtype": "float64",
     "is_save_binary_file": "false",
-    "enable_load_from_binary_file": "false"})
-train = load_dataset(os.path.join(EX, "binary.train"), cfg,
-                     rank=rank, num_shards=nproc)
+    "enable_load_from_binary_file": "false"}
+if mode == "presplit":
+    params["is_pre_partition"] = "true"
+elif mode == "lottery2r":
+    params["use_two_round_loading"] = "true"
+    params["bin_construct_sample_cnt"] = "2000"
+cfg = Config.from_params(params)
+if mode == "presplit":
+    # emulate the golden capture's pre-split inputs: rank r holds rows
+    # r, r+nproc, r+2*nproc, ... of the shared file, loaded with
+    # is_pre_partition=true (num_shards still drives distributed bin
+    # finding, reference dataset_loader.cpp:650-709)
+    train_file = out_model + ".shard.train"
+    with open(os.path.join(EX, "binary.train")) as f:
+        rows = f.readlines()
+    with open(train_file, "w") as f:
+        f.writelines(rows[rank::nproc])
+else:
+    # shared, non-pre-partitioned file: the loader's lottery replay
+    # selects this rank's rows exactly as the reference cluster's would
+    train_file = os.path.join(EX, "binary.train")
+train = load_dataset(train_file, cfg, rank=rank, num_shards=nproc)
 valid = load_dataset(os.path.join(EX, "binary.test"), cfg, reference=train)
 obj = create_objective(cfg)
 obj.init(train.metadata, train.num_data)

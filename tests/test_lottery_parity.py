@@ -1,0 +1,209 @@
+"""Multi-machine row-lottery parity vs the reference's own code.
+
+tests/lottery_probe.cpp drives the REFERENCE's header-only
+TextReader/Random (compiled from /root/reference/include with the same
+g++/libstdc++ that builds the reference binary) through the exact
+filter/sample call pattern of DatasetLoader::LoadTextDataToMemory /
+SampleTextDataFromFile (src/io/dataset_loader.cpp:467-572).  These
+tests assert that load_dataset's rank shards — and the two-round
+bin-sample reservoir — replay the reference's draw stream row for row,
+in both row and query granularity, one-round and two-round.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REF_INCLUDE = os.environ.get("LGT_REFERENCE_DIR", "/root/reference") \
+    + "/include"
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+_probe_path = None
+
+
+def _probe_exe(tmp_path_factory):
+    global _probe_path
+    if _probe_path is None:
+        if not os.path.isdir(REF_INCLUDE):
+            pytest.skip("reference headers unavailable")
+        exe = str(tmp_path_factory.mktemp("probe") / "lottery_probe")
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-I" + REF_INCLUDE,
+                 "-o", exe, os.path.join(HERE, "lottery_probe.cpp")],
+                check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            pytest.skip("cannot build lottery probe: %s" % e)
+        _probe_path = exe
+    return _probe_path
+
+
+@pytest.fixture(scope="module")
+def probe(tmp_path_factory):
+    exe = _probe_exe(tmp_path_factory)
+
+    def run(mode, data_file, seed, machines, rank, sample_cnt,
+            query_file=None):
+        args = [exe, mode, data_file, str(seed), str(machines),
+                str(rank), str(sample_cnt)]
+        if query_file:
+            args.append(query_file)
+        out = subprocess.run(args, capture_output=True, text=True,
+                             check=True).stdout.splitlines()
+        total = int(out[0].split("=")[1])
+        used = [int(x) for x in out[1].split(":", 1)[1].split()]
+        sampled = [ln[2:] for ln in out[2:] if ln.startswith("s=")]
+        sample_idx = None
+        for ln in out[2:]:
+            if ln.startswith("sample_idx:"):
+                sample_idx = [int(x) for x in ln.split(":", 1)[1].split()]
+        return total, used, sampled, sample_idx
+
+    return run
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("lottery")
+    rng = np.random.RandomState(7)
+    n = 157
+    X = np.round(rng.rand(n, 3) * 10, 3)
+    y = (rng.rand(n) > 0.5).astype(int)
+    body = "".join("%d\t%g\t%g\t%g\n" % (y[i], X[i, 0], X[i, 1], X[i, 2])
+                   for i in range(n))
+    row_file = str(d / "row.tsv")
+    with open(row_file, "w") as f:
+        f.write(body)
+    q_file = str(d / "q.tsv")
+    with open(q_file, "w") as f:
+        f.write(body)
+    sizes = [13, 9, 21, 7, 30, 17, 11, 19, 16, 14]
+    assert sum(sizes) == n
+    with open(q_file + ".query", "w") as f:
+        f.write("\n".join(map(str, sizes)) + "\n")
+    return {"n": n, "row": row_file, "q": q_file, "sizes": sizes,
+            "lines": body.splitlines()}
+
+
+def _parse_rows(rows):
+    """Parse raw data lines exactly as the loader does (Atof-parity
+    parser — Python float() differs by ulps on knife-edge values)."""
+    from lightgbm_tpu.io.parser import parse_file_bytes
+    raw = ("\n".join(rows) + "\n").encode()
+    _, feats, _ = parse_file_bytes(raw, 0)
+    return feats
+
+
+def _load(f, rank, shards, two_round, sample_cnt=200000):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import load_dataset
+    cfg = Config.from_params({
+        "objective": "binary", "data_random_seed": "1",
+        "bin_construct_sample_cnt": str(sample_cnt),
+        "use_two_round_loading": "true" if two_round else "false",
+        "is_save_binary_file": "false", "label_column": "0"})
+    return load_dataset(f, cfg, rank=rank, num_shards=shards)
+
+
+@pytest.mark.parametrize("granularity", ["row", "query"])
+@pytest.mark.parametrize("machines", [2, 3])
+def test_one_round_row_sets_match_reference(probe, data, granularity,
+                                            machines):
+    """One-round sharding: per-rank rows equal the reference lottery's
+    (ReadAndFilterLines, dataset_loader.cpp:476-511), and because every
+    rank replays the identical stream the shards partition the file."""
+    f = data["q" if granularity == "query" else "row"]
+    qf = f + ".query" if granularity == "query" else None
+    allsets = []
+    for rank in range(machines):
+        _, used, _, _ = probe("oneround", f, 1, machines, rank, 50, qf)
+        ds = _load(f, rank, machines, two_round=False)
+        assert ds.local_rows.tolist() == used
+        allsets.append(used)
+    merged = np.sort(np.concatenate(allsets))
+    np.testing.assert_array_equal(merged, np.arange(data["n"]))
+
+
+@pytest.mark.parametrize("machines", [2, 3])
+def test_one_round_bin_sample_continues_lottery_stream(probe, data,
+                                                       machines):
+    """The one-round bin sample draws Random::Sample on the SAME stream
+    the lottery advanced (DatasetLoader keeps one random_ member):
+    sub-sampled bin boundaries must come from exactly the probe's
+    sample_idx rows."""
+    from lightgbm_tpu.io.binning import find_bin
+    f = data["row"]
+    for rank in range(machines):
+        _, used, _, sample_idx = probe("oneround", f, 1, machines, rank, 40)
+        ds = _load(f, rank, machines, two_round=False, sample_cnt=40)
+        # reproduce expected boundaries from the probe's sampled rows
+        rows = [data["lines"][used[i]] for i in sample_idx]
+        feats = _parse_rows(rows)
+        for j, mapper in enumerate(ds.bin_mappers):
+            want = find_bin(feats[:, j], len(rows), 255)
+            np.testing.assert_array_equal(mapper.bin_upper_bound,
+                                          want.bin_upper_bound)
+
+
+@pytest.mark.parametrize("granularity", ["row", "query"])
+@pytest.mark.parametrize("machines", [2, 3])
+def test_two_round_row_sets_and_reservoir_match_reference(
+        probe, data, granularity, machines):
+    """Two-round sharding: the lottery interleaves with the bin-sample
+    reservoir on ONE stream (SampleAndFilterFromFile,
+    text_reader.h:186-211).  Per-rank row sets AND the reservoir
+    contents must replay the reference's draws exactly — including the
+    reference's stream-desync quirk: once any rank's reservoir passes
+    its fill, ranks' streams diverge and the shards need not partition
+    the file (sample_cnt=40 << local rows forces that regime here)."""
+    from lightgbm_tpu.io.binning import find_bin
+    f = data["q"] if granularity == "query" else data["row"]
+    qf = f + ".query" if granularity == "query" else None
+    counts = []
+    for rank in range(machines):
+        _, used, sampled, _ = probe("tworound", f, 1, machines, rank, 40, qf)
+        ds = _load(f, rank, machines, two_round=True, sample_cnt=40)
+        assert ds.local_rows.tolist() == used
+        counts.append(len(used))
+        # reservoir parity via bin boundaries built from the probe's
+        # sampled lines (the loader's reservoir feeds find_bin directly)
+        feats = _parse_rows(sampled)
+        for j, mapper in enumerate(ds.bin_mappers):
+            want = find_bin(feats[:, j], len(sampled), 255)
+            np.testing.assert_array_equal(mapper.bin_upper_bound,
+                                          want.bin_upper_bound)
+    assert sum(counts) > 0
+
+
+@pytest.mark.parametrize("two_round", [False, True])
+def test_zero_size_query_fatals_under_lottery(tmp_path, data, two_round):
+    """Zero-count sidecar queries make the reference's crossing-based
+    lottery split the following query across ranks, which its own
+    Metadata::CheckOrPartition fatals on (metadata.cpp:154-165) — the
+    loader must refuse them up front under distributed loading."""
+    from lightgbm_tpu.utils.log import LightGBMError
+    f = str(tmp_path / "zq.tsv")
+    with open(data["q"]) as src, open(f, "w") as dst:
+        dst.write(src.read())
+    sizes = list(data["sizes"])
+    sizes[2:2] = [0]
+    with open(f + ".query", "w") as qf:
+        qf.write("\n".join(map(str, sizes)) + "\n")
+    with pytest.raises(LightGBMError, match="zero-size"):
+        _load(f, 0, 2, two_round=two_round)
+    # single-machine loading of the same file stays permissive
+    assert _load(f, 0, 1, two_round=two_round).num_data == data["n"]
+
+
+def test_two_round_equals_one_round_below_fill(data):
+    """With bin_construct_sample_cnt covering every local row the
+    reservoir never draws, the streams never desync, and the two-round
+    shards equal the one-round shards (both = pure lottery)."""
+    for rank in range(2):
+        a = _load(data["row"], rank, 2, two_round=False)
+        b = _load(data["row"], rank, 2, two_round=True)
+        np.testing.assert_array_equal(a.local_rows, b.local_rows)
+        np.testing.assert_array_equal(a.bins, b.bins)
+        np.testing.assert_array_equal(a.metadata.label, b.metadata.label)
