@@ -110,11 +110,16 @@ def run_ours():
     booster = create_boosting(cfg, ds, obj)
     setup_s = time.time() - t0
 
-    # warm-up: one iteration on a throwaway booster triggers all XLA
-    # compilations (cached by shape for the real run)
+    # warm-up: TWO iterations on a throwaway booster trigger all XLA
+    # compilations (cached by shape for the real run).  Two, not one:
+    # under ordered-partition growth iteration 1 dispatches the
+    # REORDER step variant and iteration 2 the plain variant
+    # (gbdt._run_fused), so a single-iteration warm-up left the plain
+    # step's ~20s cold compile inside the timed loop.
     warm = create_boosting(cfg, ds, obj)
     t0 = time.time()
-    warm.train_one_iter(None, None, False)
+    for _ in range(2):
+        warm.train_one_iter(None, None, False)
     jax.block_until_ready(warm.scores)
     compile_s = time.time() - t0
     del warm

@@ -109,6 +109,9 @@ def _unpack_bag(bag_mask, n_pad):
     return bag_mask
 
 
+_unpack_bag_jit = jax.jit(_unpack_bag, static_argnums=1)
+
+
 @jax.jit
 def _permute_packed_bag(packed: jax.Array, row_order: jax.Array):
     """File-order packed bag bits -> ordered-space bool mask."""
@@ -980,6 +983,15 @@ class GBDT:
 
         fn = _get_fused_step(key, make)
         if reorder:
+            # the reorder executable must see ONE bag-mask signature:
+            # dispatches under an active row order pass the cached
+            # ordered bool mask, so the first (identity-order) dispatch
+            # unpacks its packed upload here — otherwise the second
+            # re-sort retraces and recompiles the whole ~20s step with
+            # bool[n] in place of u8[n/8] (observed as a mid-training
+            # stall exactly at iteration hist_reorder_every+1)
+            if bag_mask_dev.dtype == jnp.uint8:
+                bag_mask_dev = _unpack_bag_jit(bag_mask_dev, self.n_pad)
             order = (self._row_order if self._row_order is not None
                      else jnp.arange(self.n_pad, dtype=jnp.int32))
             (scores, valid, ints, floats, bins_new, bag_new, gstate_new,

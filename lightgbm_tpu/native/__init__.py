@@ -543,7 +543,42 @@ class ShardLottery:
             if k:
                 self._keep_cur = bool(keep[-1])
             return keep, slot
-        for i in range(k):
+        start = 0
+        if new_unit is None and self._sample_cnt >= 0:
+            # reservoir FILL phase consumes no draws, so rows stay
+            # lottery-only until a kept row would pass the fill: draw the
+            # chunk vectorized, accept the prefix that stays in fill, and
+            # rewind/replay for the rest (the scalar walk below).  At the
+            # default bin_construct_sample_cnt this covers whole files.
+            while start < k and self._filled < self._sample_cnt:
+                rem = k - start
+                saved = self._rng.get_state()
+                draws = self._rng.next_ints(
+                    np.full(rem, self._m, dtype=np.int64))
+                kv = draws == self._rank
+                room = self._sample_cnt - self._filled
+                over = np.cumsum(kv) > room
+                j = int(np.argmax(over)) if over.any() else rem
+                if j < rem:
+                    # row start+j needs a reservoir draw: rewind, replay
+                    # only the accepted prefix (identical draws, identical
+                    # rejection consumption), fall through to the walk
+                    self._rng.set_state(saved)
+                    if j:
+                        self._rng.next_ints(
+                            np.full(j, self._m, dtype=np.int64))
+                kj = kv[:j]
+                keep[start:start + j] = kj
+                fills = np.flatnonzero(kj)
+                slot[start + fills] = self._filled + np.arange(len(fills))
+                self._filled += len(fills)
+                self._local_cnt += len(fills)
+                if j:
+                    self._keep_cur = bool(kj[-1])
+                start += j
+                if j < rem:
+                    break
+        for i in range(start, k):
             if new_unit is None or new_unit[i]:
                 draw = int(self._rng.next_ints([self._m])[0])
                 self._keep_cur = draw == self._rank
