@@ -37,9 +37,10 @@ NO_LIMIT = -1
 
 
 class _PendingTree:
-    """A trained tree still packed in device buffers (async host copy in
-    flight); GBDT._flush_pending unpacks batches of these into host Trees
-    without blocking the per-iteration dispatch pipeline."""
+    """A trained tree still packed in device buffers; GBDT._flush_pending
+    stacks every pending tree's buffers and pulls them host-side in one
+    transfer, then unpacks them into host Trees — the per-iteration
+    dispatch pipeline never blocks on a device->host roundtrip."""
 
     __slots__ = ("ints", "floats", "lr", "gated")
 
@@ -337,7 +338,7 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
     return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
-def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype):
+def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False):
     """Fused MULTICLASS iteration (VERDICT r3 #4): gradients for all K
     classes from the pre-iteration scores, then a class-wise lax.scan
     grows the K per-iteration trees in ONE dispatch — the reference's
@@ -350,9 +351,23 @@ def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype):
 
     bag_masks [K, N] bool and fmasks [K, F] bool are per-class (each
     class draws its own mt19937 masks, one TreeLearner per class in the
-    reference, gbdt.cpp:38-45)."""
+    reference, gbdt.cpp:38-45).
+
+    `reorder` (round 4) extends the ordered-partition growth to
+    multiclass with ONE shared row order sorted by the JOINT leaf key —
+    a stable lexicographic sort over all K of this iteration's leaf
+    assignments.  The K trees differ, but they are correlated (they
+    model the same data), so the joint cells are homogeneous in EVERY
+    class: measured at the 1M x 28 bench, the joint order cuts
+    block-sweeps ~10x for every class — better even than giving each
+    class its own order, and it needs no per-iteration gathers (a
+    per-class-orders prototype spent more on [F, N] gathers than the
+    clustered sweeps saved; gathers run ~100x off HBM bandwidth on
+    TPU).  All per-row state (scores [K, N], bins, bag masks, the
+    objective's onehot/weights, the composed row order) permutes in
+    the SAME dispatch, exactly like the single-class reorder step."""
     def step(scores, valid_scores, bag_masks, fmasks, bins, valid_bins,
-             gstate, stopped):
+             gstate, stopped, *row_order):
         grad, hess = grad_fn(scores, gstate)            # [K, N] each
         num_class = grad.shape[0]
 
@@ -373,14 +388,39 @@ def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype):
                     dev_tree.left_child, dev_tree.right_child, vbins)
                 new_vss.append(vs.at[cls].add(leaf_vals[vleaf]))
             ints, floats = _pack_tree(dev_tree)
-            return (sc, tuple(new_vss), stop), (ints, floats)
+            ys = ((ints, floats, leaf_id) if reorder else (ints, floats))
+            return (sc, tuple(new_vss), stop), ys
 
-        (scores, vss, stopped), (ints_k, floats_k) = jax.lax.scan(
+        (scores, vss, stopped), ys = jax.lax.scan(
             body, (scores, tuple(valid_scores), stopped),
             (jnp.arange(num_class, dtype=jnp.int32), grad, hess,
              bag_masks, fmasks))
-        return scores, list(vss), ints_k, floats_k, stopped
-    return jax.jit(step, donate_argnums=(0, 1))
+        if not reorder:
+            ints_k, floats_k = ys
+            return scores, list(vss), ints_k, floats_k, stopped
+        ints_k, floats_k, leaf_k = ys                   # leaf_k [K, N]
+        # stable lexicographic sort, class 0 primary: chained stable
+        # argsorts from the least-significant class up (np.lexsort's
+        # construction), composing the relative permutation
+        rel = jnp.argsort(leaf_k[num_class - 1],
+                          stable=True).astype(jnp.int32)
+        for k in range(num_class - 2, -1, -1):
+            keys = jnp.take(leaf_k[k], rel)
+            rel = jnp.take(rel, jnp.argsort(keys,
+                                            stable=True).astype(jnp.int32))
+        bins_new = jnp.take(bins, rel, axis=1)
+        scores = jnp.take(scores, rel, axis=1)
+        bag_new = jnp.take(bag_masks, rel, axis=1)
+        gstate_new = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, rel, axis=-1), gstate)
+        order_new = jnp.take(row_order[0], rel)
+        return (scores, list(vss), ints_k, floats_k, stopped,
+                bins_new, bag_new, gstate_new, order_new)
+    # gstate is NOT donated: on the first re-sort it aliases the
+    # objective's own arrays (same constraint as the single-class
+    # reorder step)
+    return jax.jit(step,
+                   donate_argnums=(0, 1, 2, 4, 8) if reorder else (0, 1))
 
 
 def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
@@ -866,9 +906,15 @@ class GBDT:
     def _bag_masks_stacked_dev(self):
         """[K, n_pad] bool device stack of the per-class bag masks for
         the multiclass fused step; rebuilt only when re-bagging
-        invalidated it (_bagging clears the cache)."""
+        invalidated it (_bagging clears the cache).  Host masks stay in
+        FILE order (mt19937 parity); under an active shared row order
+        the rebuilt stack permutes once on device — the reorder step
+        keeps the cached stack permuted thereafter."""
         if self._bag_stacked is None:
-            self._bag_stacked = jnp.asarray(np.stack(self.bag_masks))
+            m = jnp.asarray(np.stack(self.bag_masks))
+            if self._row_order is not None:
+                m = jnp.take(m, self._row_order, axis=1)
+            self._bag_stacked = m
         return self._bag_stacked
 
     def _run_fused_multi(self):
@@ -878,35 +924,52 @@ class GBDT:
             self._bagging(self.iter, cls)
         fmasks = np.stack([self._feature_mask(c)
                            for c in range(self.num_class)])
-        gstate = self.objective.grad_state()
+        # shared-joint-order ordered-partition growth (round 4): same
+        # gate and cadence as the single-class reorder — re-sort after
+        # the first iteration, then every reorder_every
+        ordered_on = (self.hist_ranged and self.grower is None
+                      and getattr(self.objective, "row_permutable", False))
+        reorder = (ordered_on
+                   and self._trees_since_reorder
+                   >= (0 if self._row_order is None
+                       else self.reorder_every - 1))
+        gstate = (self._gstate_override if self._gstate_override is not None
+                  else self.objective.grad_state())
         key = ("multi", self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
-               self.hist_slots, self.hist_compact, self.hist_ranged)
+               self.hist_slots, self.hist_compact, self.hist_ranged,
+               reorder)
 
         def make():
             grow_kw = self._grow_kw()
             return _make_fused_step_multi(self.objective.make_grad_fn(),
-                                          grow_kw, lr, self.dtype)
+                                          grow_kw, lr, self.dtype,
+                                          reorder)
 
         fn = _get_fused_step(key, make)
-        (scores, valid, ints_k, floats_k, self._dev_stopped) = fn(
-            self.scores, list(self.valid_scores),
-            self._bag_masks_stacked_dev(), jnp.asarray(fmasks),
-            self.bins_dev, tuple(self.valid_bins_dev), gstate,
-            self._dev_stopped)
+        common = (self.scores, list(self.valid_scores),
+                  self._bag_masks_stacked_dev(), jnp.asarray(fmasks),
+                  self.bins_dev, tuple(self.valid_bins_dev), gstate,
+                  self._dev_stopped)
+        if reorder:
+            order = (self._row_order if self._row_order is not None
+                     else jnp.arange(self.n_pad, dtype=jnp.int32))
+            (scores, valid, ints_k, floats_k, self._dev_stopped,
+             self.bins_dev, self._bag_stacked, self._gstate_override,
+             self._row_order) = fn(*common, order)
+            self._inv_order = None
+            self._trees_since_reorder = 0
+        else:
+            (scores, valid, ints_k, floats_k,
+             self._dev_stopped) = fn(*common)
+            self._trees_since_reorder += 1
         self.scores = scores
         self.valid_scores = list(valid)
-        pending = []
-        for c in range(self.num_class):
-            ints, floats = ints_k[c], floats_k[c]
-            for a in (ints, floats):
-                try:
-                    a.copy_to_host_async()
-                except AttributeError:
-                    pass
-            pending.append(_PendingTree(ints, floats, lr, gated=True))
-        return pending
+        # device row slices stay unmaterialized: _flush_pending stacks
+        # and pulls every pending tree in ONE transfer
+        return [_PendingTree(ints_k[c], floats_k[c], lr, gated=True)
+                for c in range(self.num_class)]
 
     def _reorder_enabled(self) -> bool:
         # bagging composes with the ordered partition since round 3:
@@ -1013,11 +1076,6 @@ class GBDT:
             self._trees_since_reorder += 1
         self.scores = scores
         self.valid_scores = list(valid)
-        for a in (ints, floats):
-            try:
-                a.copy_to_host_async()
-            except AttributeError:
-                pass
         return _PendingTree(ints, floats, lr, gated=True)
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
@@ -1077,16 +1135,10 @@ class GBDT:
             self.valid_scores[i] = (
                 self.valid_scores[i].at[cls].add(leaf_vals[vleaf]))
 
-        # Pack the tree into two flat buffers and start an async
-        # device->host copy: by the time the next flush unpacks it, the
-        # bytes are already on the host, so training never blocks on a
-        # per-iteration roundtrip.
+        # Pack the tree into two flat device buffers; the next flush
+        # stacks every pending tree and pulls them in one transfer, so
+        # training never blocks on a per-iteration roundtrip.
         ints, floats = _pack_tree(dev_tree)
-        for a in (ints, floats):
-            try:
-                a.copy_to_host_async()
-            except AttributeError:
-                pass
         return _PendingTree(ints, floats, lr)
 
     # -- lazy host materialization ------------------------------------
@@ -1111,6 +1163,21 @@ class GBDT:
         (models_ keeps partials, gbdt.cpp:186-197; prediction floors
         num_used_model_ = size/num_class, gbdt.cpp:455,489).  Returns True
         when training must stop."""
+        # ONE device->host pull for every pending tree: on the remote-
+        # attached TPU each small-array transfer is a ~tens-of-ms tunnel
+        # round-trip (measured: a 5-class iteration spent ~380 of its
+        # 414 ms pulling ten per-class tree buffers), so the flush
+        # stacks all pending ints/floats on device (this also fuses
+        # multiclass batch-row slices) and materializes them in two
+        # transfers, amortized over _flush_every iterations
+        pend = [m for m in self._models
+                if isinstance(m, _PendingTree)
+                and not isinstance(m.ints, np.ndarray)]
+        if pend:
+            ints_all = np.asarray(jnp.stack([m.ints for m in pend]))
+            floats_all = np.asarray(jnp.stack([m.floats for m in pend]))
+            for m, ih, fh in zip(pend, ints_all, floats_all):
+                m.ints, m.floats = ih, fh
         stop_at = None
         gated_flags = {}
         for idx, m in enumerate(self._models):
@@ -1223,6 +1290,7 @@ class GBDT:
         self.bins_dev = jnp.asarray(bins)
         self._bag_dev = [None] * self.num_class
         self._bag_dev_packed = [None] * self.num_class
+        self._bag_stacked = None
         self._row_order = None
         self._inv_order = None
         self._gstate_override = None
@@ -1989,11 +2057,6 @@ class DART(GBDT):
             jnp.int32(self._bank_count))
         self._bank = [bi, bf, lb, list(vbs)]
         self.valid_scores = list(valid)
-        for a in (ints, floats):
-            try:
-                a.copy_to_host_async()
-            except AttributeError:
-                pass
         # raw floats + this iteration's 1/(1+k) shrinkage applied on the
         # host in f64, like every other fused path
         self._models.append(_PendingTree(ints, floats,

@@ -209,6 +209,9 @@ class MulticlassSoftmax(Objective):
     # (gbdt._make_fused_step_multi): one dispatch grows all K
     # per-iteration trees via a class-wise lax.scan
     jax_traceable = True
+    # onehot [K, N] / weights [N] both permute on their last axis, so
+    # the shared-joint-order multiclass reorder may carry them
+    row_permutable = True
 
     def __init__(self, config: Config):
         self.num_class = config.num_class
