@@ -484,6 +484,9 @@ class Booster:
     def predict(self, data, raw_score: bool = False,
                 pred_leaf: bool = False,
                 num_iteration: int = -1) -> np.ndarray:
+        if _is_sparse(data):
+            return self._predict_sparse(data, raw_score, pred_leaf,
+                                        num_iteration)
         mat = _as_dense(data)
         saved = self._gbdt.num_used_model
         if num_iteration > 0:    # <= 0 means all iterations (c_api.h:313)
@@ -499,6 +502,43 @@ class Booster:
         finally:
             self._gbdt.num_used_model = saved
         return out[0] if out.shape[0] == 1 else out.T
+
+    # bound on the dense chunk buffer used by sparse prediction:
+    # 4M doubles (~32 MB), split across however many rows fit (the
+    # predict pipeline makes a handful of same-size transients per
+    # chunk, so peak is a small multiple of this)
+    _SPARSE_PREDICT_BUDGET = 1 << 22
+
+    def _predict_sparse(self, data, raw_score: bool, pred_leaf: bool,
+                        num_iteration: int) -> np.ndarray:
+        """O(nnz) CSR/CSC prediction (VERDICT r4 #4; reference
+        LGBM_BoosterPredictForCSR/CSC, c_api.cpp:529-556 with the row
+        adapters :589-700): the matrix is never densified — rows stream
+        through a bounded [chunk, F] buffer where only PRESENT entries
+        are filled (absent features read 0.0, the reference's sparse
+        convention), so peak memory is O(nnz + chunk*F) regardless of
+        the matrix shape.  Output is identical to the densified path."""
+        csr = data.tocsr()      # CSC converts in O(nnz)
+        n, f = csr.shape
+        chunk = max(1, min(GBDT.PREDICT_CHUNK,
+                           self._SPARSE_PREDICT_BUDGET // max(f, 1)))
+        outs = []
+        block = np.zeros((min(chunk, n), f), dtype=np.float64)
+        for a in range(0, n, chunk):
+            m = min(chunk, n - a)
+            sub = csr[a:a + m]
+            blk = block[:m]
+            blk[:] = 0.0
+            rows = np.repeat(np.arange(m), np.diff(sub.indptr))
+            blk[rows, sub.indices] = sub.data
+            # every per-chunk result concatenates on its ROW axis:
+            # binary/regression -> [m], multiclass -> [m, K] (already
+            # transposed by predict), pred_leaf -> [m, T]
+            outs.append(self.predict(blk, raw_score, pred_leaf,
+                                     num_iteration))
+        if not outs:
+            return np.zeros(0)
+        return np.concatenate(outs, axis=0)
 
     # -- model io (LGBM_BoosterSaveModel / LoadModelFromString) ---------
     def save_checkpoint(self, path: str) -> None:

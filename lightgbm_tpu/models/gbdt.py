@@ -40,7 +40,11 @@ class _PendingTree:
     """A trained tree still packed in device buffers; GBDT._flush_pending
     stacks every pending tree's buffers and pulls them host-side in one
     transfer, then unpacks them into host Trees — the per-iteration
-    dispatch pipeline never blocks on a device->host roundtrip."""
+    dispatch pipeline never blocks on a device->host roundtrip.
+
+    Invariant: every pending ints/floats pair in one booster has the
+    SAME shape — _pack_tree pads to the config-fixed leaf count, and
+    the flush's jnp.stack relies on it (asserted there)."""
 
     __slots__ = ("ints", "floats", "lr", "gated")
 
@@ -153,14 +157,24 @@ def _make_fused_step(grad_fn, grow_kw, lr, dtype):
                    donate_argnums=(0, 1))
 
 
-def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype):
+def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
+                             permute_state=None):
     """The fused step PLUS the ordered-partition row re-sort: after the
     tree lands, rows are stably re-sorted by its leaf assignment so later
     trees' leaves stay block-clustered and the block-list sweeps
     (ops/grow.py ranged mode) touch few blocks.  Everything per-row
     (bins, scores, bag mask, objective state, the composed row order)
     comes back permuted in the SAME dispatch; valid sets and tree output
-    are row-order-free."""
+    are row-order-free.
+
+    `permute_state` is the objective's make_permute_fn (how its
+    grad_state follows the permutation — default: every leaf per-row on
+    its last axis; lambdarank remaps its doc_idx row positions)."""
+    if permute_state is None:
+        def permute_state(gstate, rel):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.take(a, rel, axis=-1), gstate)
+
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
              gstate, row_order, stopped):
         bag = _unpack_bag(bag_mask, bins.shape[1])
@@ -187,18 +201,19 @@ def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype):
         bins_new = jnp.take(bins, rel, axis=1)
         scores = jnp.take(scores, rel, axis=1)
         bag_new = jnp.take(bag, rel)
-        gstate_new = jax.tree_util.tree_map(
-            lambda a: jnp.take(a, rel, axis=-1), gstate)
+        gstate_new = permute_state(gstate, rel)
         order_new = jnp.take(row_order, rel)
         return (scores, new_valid, ints, floats, bins_new, bag_new,
                 gstate_new, order_new, stopped)
     return step
 
 
-def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype):
+def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype,
+                             permute_state=None):
     # gstate is NOT donated: on the first re-sort it aliases the
     # objective's own arrays, which must stay valid for metrics/restarts
-    return jax.jit(_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype),
+    return jax.jit(_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
+                                            permute_state),
                    donate_argnums=(0, 1, 2, 4, 7))
 
 
@@ -338,7 +353,8 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves):
     return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
-def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False):
+def _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder=False,
+                           permute_state=None):
     """Fused MULTICLASS iteration (VERDICT r3 #4): gradients for all K
     classes from the pre-iteration scores, then a class-wise lax.scan
     grows the K per-iteration trees in ONE dispatch — the reference's
@@ -411,20 +427,64 @@ def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False):
         bins_new = jnp.take(bins, rel, axis=1)
         scores = jnp.take(scores, rel, axis=1)
         bag_new = jnp.take(bag_masks, rel, axis=1)
-        gstate_new = jax.tree_util.tree_map(
-            lambda a: jnp.take(a, rel, axis=-1), gstate)
+        gstate_new = (permute_state(gstate, rel) if permute_state
+                      is not None else jax.tree_util.tree_map(
+                          lambda a: jnp.take(a, rel, axis=-1), gstate))
         order_new = jnp.take(row_order[0], rel)
         return (scores, list(vss), ints_k, floats_k, stopped,
                 bins_new, bag_new, gstate_new, order_new)
+    return step
+
+
+def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False,
+                           permute_state=None):
     # gstate is NOT donated: on the first re-sort it aliases the
     # objective's own arrays (same constraint as the single-class
     # reorder step)
-    return jax.jit(step,
+    return jax.jit(_fused_step_multi_body(grad_fn, grow_kw, lr, dtype,
+                                          reorder, permute_state),
                    donate_argnums=(0, 1, 2, 4, 8) if reorder else (0, 1))
 
 
+def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
+                                   n_valid, gstate_specs, reorder,
+                                   permute_state=None):
+    """The multiclass fused step under shard_map for single-host
+    tree_learner=data (VERDICT r4 #3): the class-wise scan body already
+    threads psum_axis through grow_kw, so sharding it is the same
+    transform as the single-class _make_fused_step_sharded — per-row
+    state ([K, N] scores/bag masks, bins, gradient state, row order)
+    shards along the data axis, valid sets and the K packed trees are
+    replicated, and the joint-leaf-key re-sort stays SHARD-LOCAL."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    body = _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder,
+                                  permute_state)
+    row = P(DATA_AXIS)
+    row2 = P(None, DATA_AXIS)
+    rep = P()
+    vrep = [rep] * n_valid
+    common_in = (row2, vrep, row2, rep, row2, tuple(vrep), gstate_specs,
+                 rep)
+    if reorder:
+        in_specs = common_in + (row,)
+        out_specs = (row2, vrep, rep, rep, rep, row2, row2, gstate_specs,
+                     row)
+        donate = (0, 1, 2, 4, 8)
+    else:
+        in_specs = common_in
+        out_specs = (row2, vrep, rep, rep, rep)
+        donate = (0, 1)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    return jax.jit(fn, donate_argnums=donate)
+
+
 def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
-                             n_valid, gstate_specs, reorder):
+                             n_valid, gstate_specs, reorder,
+                             permute_state=None):
     """The fused step under shard_map for single-host tree_learner=data
     (VERDICT r3 #2): per-row state (scores row, bins, bag mask, gradient
     state, row order) shards along the data axis, valid sets and tree
@@ -439,8 +499,9 @@ def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
 
     from ..parallel.mesh import DATA_AXIS
 
-    body = (_fused_step_body_reorder if reorder
-            else _fused_step_body)(grad_fn, grow_kw, lr, dtype)
+    body = (_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
+                                     permute_state) if reorder
+            else _fused_step_body(grad_fn, grow_kw, lr, dtype))
     row = P(DATA_AXIS)
     row2 = P(None, DATA_AXIS)
     rep = P()
@@ -474,6 +535,7 @@ class GBDT:
         self._models: List = []       # Tree | _PendingTree (see models prop)
         self._stopped = False
         self._fused_sharded = False
+        self._mh_fused = False
         self._flush_every = 1   # recomputed below once bagging state is known
         self.num_used_model = 0
         self.early_stopping_round = config.early_stopping_round
@@ -621,14 +683,30 @@ class GBDT:
             self.hist_compact = ((half + row_unit - 1)
                                  // row_unit) * row_unit
 
-        # single-host tree_learner=data can run the fused step (and the
-        # ordered partition below) under shard_map: every per-row array
-        # shards along the data axis and re-sorts stay shard-local
-        # (_make_fused_step_sharded).  Multi-host keeps the general path
-        # (per-row state is process-local, reassembled per tree); voting
-        # keeps it too (its per-split protocol is latency-bound anyway).
-        self._fused_sharded = (self.rows_sharded and not self._mh
-                               and config.tree_learner == "data")
+        # tree_learner=data can run the fused step (and the ordered
+        # partition below) under shard_map: every per-row array shards
+        # along the data axis and re-sorts stay shard-local
+        # (_make_fused_step_sharded).  Since round 5 this includes
+        # MULTI-HOST (VERDICT r4 #2): per-row state is assembled into
+        # global sharded arrays ONCE (scores/objective state/bag masks),
+        # the fused dispatch keeps gradients on device, and per-iteration
+        # host traffic drops to O(packed tree) — the per-tree
+        # [N_local] grad/hess device->host->device round trip of the
+        # general path is gone.  Multi-host additionally needs a
+        # row-shardable traceable objective up front (the general path
+        # cannot hand its local scores to a global fused step
+        # mid-training, so the choice is made here, not per-iteration);
+        # voting keeps the general path (its per-split protocol is
+        # latency-bound anyway).
+        mh_fusible = (type(self) is GBDT and self.num_class == 1
+                      and objective is not None
+                      and getattr(objective, "jax_traceable", False)
+                      and getattr(objective, "row_shardable", False)
+                      and objective.fused_key() is not None)
+        self._fused_sharded = (self.rows_sharded
+                               and config.tree_learner == "data"
+                               and (not self._mh or mh_fusible))
+        self._mh_fused = self._mh and self._fused_sharded
 
         # ordered-partition growth (pallas learner, serial or single-host
         # data-parallel): block-list sweeps are always on (bit-identical
@@ -662,10 +740,17 @@ class GBDT:
         if self.grower is not None:
             self.bins_dev = self.grower.shard_bins(bins)
             if self.rows_sharded and not self._mh:
-                # multi-host keeps scores process-local; single-host
-                # shards them so the leaf_id gather-add stays on-device
+                # single-host: shard scores so the leaf_id gather-add
+                # stays on-device
                 self.scores = jax.device_put(
                     self.scores, self.grower.row_sharding_2d())
+            elif self._mh_fused:
+                # multi-host fused: scores become a GLOBAL row-sharded
+                # array once — every later iteration touches them only
+                # inside the fused dispatch (process p's file rows live
+                # at global positions [p*n_pad, (p+1)*n_pad))
+                self.scores = self.grower.shard_rows(
+                    np.asarray(self.scores), self.n_pad)
         else:
             self.bins_dev = jnp.asarray(bins)
         if objective is not None and self.n_pad != n:
@@ -706,7 +791,10 @@ class GBDT:
                                 and config.feature_fraction >= 1.0)))
                       or self._can_fuse_multi())
         self._flush_every = 16 if deferrable else 1
-        self._dev_stopped = jnp.asarray(False)
+        # multi-host fused: every input of the global fused dispatch must
+        # be a global array, including the scalar stopped flag
+        self._dev_stopped = (self.grower.replicate(np.asarray(False))
+                             if self._mh_fused else jnp.asarray(False))
         self.bag_rng = Mt19937Random(config.bagging_seed)
         self.bag_masks = []
         for _ in range(self.num_class):
@@ -737,15 +825,20 @@ class GBDT:
             log.fatal("Cannot add validation data after training started")
         self.valid_data.append(data)
         self.valid_metrics.append(list(metrics))
-        self.valid_bins_dev.append(jnp.asarray(data.bins))
+        # multi-host fused: valid arrays enter the global fused dispatch
+        # as REPLICATED globals (every process loaded the same valid
+        # file, matching the reference's per-machine valid copy)
+        put = (self.grower.replicate if self._mh_fused else jnp.asarray)
+        self.valid_bins_dev.append(put(data.bins))
         k = self.num_class
         vn = data.num_data
         if (data.metadata.init_score is not None
                 and np.asarray(data.metadata.init_score).size == vn * k):
             init = np.asarray(data.metadata.init_score, dtype=np.float32)
-            self.valid_scores.append(jnp.asarray(init.reshape(k, vn)))
+            self.valid_scores.append(put(init.reshape(k, vn)))
         else:
-            self.valid_scores.append(jnp.zeros((k, vn), dtype=jnp.float32))
+            self.valid_scores.append(put(np.zeros((k, vn),
+                                                  dtype=np.float32)))
         if self.early_stopping_round > 0:
             self.best_iter.append([0] * len(metrics))
             self.best_score.append([-np.inf] * len(metrics))
@@ -800,8 +893,10 @@ class GBDT:
             # tree packing in ONE dispatch with donated score buffers
             self._bagging(self.iter, 0)
             fmask = self._feature_mask(0)
+            fmask_dev = (self.grower.replicate(fmask) if self._mh_fused
+                         else jnp.asarray(fmask))
             self._models.append(self._run_fused(
-                self._bag_mask_dev_fused(0), jnp.asarray(fmask)))
+                self._bag_mask_dev_fused(0), fmask_dev))
         elif gradients is None and self._can_fuse_multi():
             # multiclass fused iteration: all K per-iteration trees in
             # one dispatch (class-wise scan, _make_fused_step_multi)
@@ -888,18 +983,31 @@ class GBDT:
         serial learner OR single-host tree_learner=data (shard_map
         variant, _make_fused_step_sharded); DART (per-iteration score
         surgery + varying shrinkage), custom gradients, multiclass,
-        multi-host and voting/feature growers take the general path."""
+        multi-host and voting/feature growers take the general path.
+        The sharded variant additionally needs a row_shardable objective
+        (its grad_state shards along the data axis; lambdarank's
+        query-block state cannot, so rank + tree_learner=data grows
+        through the general path)."""
         return (type(self) is GBDT and self.num_class == 1
-                and (self.grower is None or self._fused_sharded)
+                and (self.grower is None
+                     or (self._fused_sharded
+                         and getattr(self.objective, "row_shardable",
+                                     False)))
                 and getattr(self.objective, "jax_traceable", False)
                 and self.objective.fused_key() is not None)
 
     def _can_fuse_multi(self) -> bool:
         """The multiclass fused iteration (_make_fused_step_multi):
-        serial learner, K > 1, traceable objective.  DART overrides via
-        type check (its per-iteration drop surgery needs host trees)."""
+        serial learner OR single-host tree_learner=data (the shard_map
+        variant, _make_fused_step_multi_sharded — VERDICT r4 #3), K > 1,
+        traceable row-shardable objective.  DART overrides via type
+        check (its per-iteration drop surgery needs host trees);
+        multi-host multiclass keeps the general per-class path."""
         return (type(self) is GBDT and self.num_class > 1
-                and self.grower is None
+                and (self.grower is None
+                     or (self._fused_sharded and not self._mh
+                         and getattr(self.objective, "row_shardable",
+                                     False)))
                 and getattr(self.objective, "jax_traceable", False)
                 and self.objective.fused_key() is not None)
 
@@ -913,7 +1021,12 @@ class GBDT:
         if self._bag_stacked is None:
             m = jnp.asarray(np.stack(self.bag_masks))
             if self._row_order is not None:
-                m = jnp.take(m, self._row_order, axis=1)
+                if self.grower is not None:
+                    # sharded fused multiclass: shard-local permute, not
+                    # a cross-shard global gather
+                    m = self.grower.permute_rows(m, self._row_order)
+                else:
+                    m = jnp.take(m, self._row_order, axis=1)
             self._bag_stacked = m
         return self._bag_stacked
 
@@ -926,8 +1039,9 @@ class GBDT:
                            for c in range(self.num_class)])
         # shared-joint-order ordered-partition growth (round 4): same
         # gate and cadence as the single-class reorder — re-sort after
-        # the first iteration, then every reorder_every
-        ordered_on = (self.hist_ranged and self.grower is None
+        # the first iteration, then every reorder_every (hist_ranged
+        # already requires serial or the fused sharded learner)
+        ordered_on = (self.hist_ranged
                       and getattr(self.objective, "row_permutable", False))
         reorder = (ordered_on
                    and self._trees_since_reorder
@@ -939,13 +1053,35 @@ class GBDT:
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
-               reorder)
+               reorder,
+               (cfg.hist_agg, self.grower.num_shards,
+                id(self.grower.mesh)) if self.grower is not None else None)
 
         def make():
             grow_kw = self._grow_kw()
+            if self.grower is not None:
+                # single-host tree_learner=data (VERDICT r4 #3): the
+                # class-wise scan under shard_map, same protocol wiring
+                # as the single-class sharded step
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.mesh import DATA_AXIS
+                grow_kw.update(psum_axis=DATA_AXIS,
+                               hist_agg=cfg.hist_agg,
+                               num_shards=self.grower.num_shards,
+                               voting_top_k=0)
+                gspecs = jax.tree_util.tree_map(
+                    lambda a: P(*([None] * (np.ndim(a) - 1)
+                                  + [DATA_AXIS])), gstate)
+                return _make_fused_step_multi_sharded(
+                    self.objective.make_grad_fn(), grow_kw, lr,
+                    self.dtype, self.grower.mesh,
+                    len(self.valid_bins_dev), gspecs, reorder,
+                    self.objective.make_permute_fn())
             return _make_fused_step_multi(self.objective.make_grad_fn(),
                                           grow_kw, lr, self.dtype,
-                                          reorder)
+                                          reorder,
+                                          self.objective.make_permute_fn())
 
         fn = _get_fused_step(key, make)
         common = (self.scores, list(self.valid_scores),
@@ -989,9 +1125,15 @@ class GBDT:
         the xla hist impl does not guarantee."""
         if self._fused_sharded:
             if self._bag_dev_packed[cls] is None:
-                m = jnp.asarray(self.bag_masks[cls])
+                # multi-host: the local file-order draw (mt19937 parity
+                # with the reference's per-machine bagging) assembles
+                # into the global row-sharded mask; the order permute is
+                # shard-local by construction (ShardedGrower.permute_rows)
+                m = (self.grower.shard_rows(self.bag_masks[cls],
+                                            self.n_pad)
+                     if self._mh_fused else jnp.asarray(self.bag_masks[cls]))
                 if self._row_order is not None:
-                    m = jnp.take(m, self._row_order)
+                    m = self.grower.permute_rows(m, self._row_order)
                 self._bag_dev_packed[cls] = m
             return self._bag_dev_packed[cls]
         if self._row_order is None:
@@ -1010,8 +1152,17 @@ class GBDT:
                    and self._trees_since_reorder
                    >= (0 if self._row_order is None
                        else self.reorder_every - 1))
-        gstate = (self._gstate_override if self._gstate_override is not None
-                  else self.objective.grad_state())
+        gstate = self._gstate_override
+        if gstate is None:
+            gstate = self.objective.grad_state()
+            if self._mh_fused:
+                # assemble the objective's process-local per-row state
+                # into global row-sharded arrays ONCE; the reorder step
+                # keeps the cached global state permuted thereafter
+                gstate = jax.tree_util.tree_map(
+                    lambda a: self.grower.shard_rows(np.asarray(a),
+                                                     self.n_pad), gstate)
+                self._gstate_override = gstate
         key = (self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
@@ -1038,11 +1189,14 @@ class GBDT:
                 return _make_fused_step_sharded(
                     self.objective.make_grad_fn(), grow_kw, lr,
                     self.dtype, self.grower.mesh,
-                    len(self.valid_bins_dev), gspecs, reorder)
-            mk = (_make_fused_step_reorder if reorder
-                  else _make_fused_step)
-            return mk(self.objective.make_grad_fn(), grow_kw, lr,
-                      self.dtype)
+                    len(self.valid_bins_dev), gspecs, reorder,
+                    self.objective.make_permute_fn())
+            if reorder:
+                return _make_fused_step_reorder(
+                    self.objective.make_grad_fn(), grow_kw, lr,
+                    self.dtype, self.objective.make_permute_fn())
+            return _make_fused_step(self.objective.make_grad_fn(),
+                                    grow_kw, lr, self.dtype)
 
         fn = _get_fused_step(key, make)
         if reorder:
@@ -1055,8 +1209,17 @@ class GBDT:
             # stall exactly at iteration hist_reorder_every+1)
             if bag_mask_dev.dtype == jnp.uint8:
                 bag_mask_dev = _unpack_bag_jit(bag_mask_dev, self.n_pad)
-            order = (self._row_order if self._row_order is not None
-                     else jnp.arange(self.n_pad, dtype=jnp.int32))
+            if self._row_order is not None:
+                order = self._row_order
+            elif self._mh_fused:
+                # global positions: process p's file rows start at
+                # p * n_pad (equal per-process blocks)
+                base = jax.process_index() * self.n_pad
+                order = self.grower.shard_rows(
+                    np.arange(base, base + self.n_pad, dtype=np.int32),
+                    self.n_pad)
+            else:
+                order = jnp.arange(self.n_pad, dtype=jnp.int32)
             (scores, valid, ints, floats, bins_new, bag_new, gstate_new,
              order_new, self._dev_stopped) = fn(
                 self.scores, list(self.valid_scores), bag_mask_dev,
@@ -1174,6 +1337,12 @@ class GBDT:
                 if isinstance(m, _PendingTree)
                 and not isinstance(m.ints, np.ndarray)]
         if pend:
+            # _pack_tree pads every tree to the config-fixed leaf count
+            # (see _PendingTree); a future variable-size packing change
+            # must group by shape before stacking
+            assert len({m.ints.shape for m in pend}) == 1 \
+                and len({m.floats.shape for m in pend}) == 1, \
+                "pending tree buffers must share one packed shape"
             ints_all = np.asarray(jnp.stack([m.ints for m in pend]))
             floats_all = np.asarray(jnp.stack([m.floats for m in pend]))
             for m, ih, fh in zip(pend, ints_all, floats_all):
@@ -1280,6 +1449,37 @@ class GBDT:
     def _restore_row_order(self) -> None:
         """Return all per-row state to FILE order (leaving the fused
         ordered-partition path: custom gradients, objective swaps)."""
+        if self._mh_fused:
+            # leaving the multi-host fused path (custom gradients): pull
+            # this process's file-order block local and fall back to the
+            # general per-tree path for the REST of training — one-way,
+            # because the general path keeps scores process-local and
+            # cannot hand them back to the global fused dispatch
+            self.scores = jnp.asarray(self._mh_local_file_scores())
+            self.valid_scores = [
+                jnp.asarray(np.asarray(v.addressable_data(0)))
+                for v in self.valid_scores]
+            self.valid_bins_dev = [
+                jnp.asarray(np.asarray(v.addressable_data(0)))
+                for v in self.valid_bins_dev]
+            self._dev_stopped = jnp.asarray(
+                bool(np.asarray(self._dev_stopped.addressable_data(0))))
+            self._mh_fused = False
+            self._fused_sharded = False
+            # the general path has no device stopped flag: deferred
+            # flushing is only sound without bagging/feature_fraction
+            # (same recompute DART's _exit_bank_mode does)
+            self._flush_every = (
+                16 if (self.num_class == 1 and not self.bagging_enabled
+                       and self.config.feature_fraction >= 1.0) else 1)
+            self._bag_dev = [None] * self.num_class
+            self._bag_dev_packed = [None] * self.num_class
+            self._bag_stacked = None
+            self._row_order = None
+            self._inv_order = None
+            self._gstate_override = None
+            self._trees_since_reorder = 0
+            return
         if self._row_order is None:
             return
         inv = self._inverse_row_order()
@@ -1296,7 +1496,24 @@ class GBDT:
         self._gstate_override = None
         self._trees_since_reorder = 0
 
+    def _mh_local_file_scores(self) -> np.ndarray:
+        """Multi-host fused: this process's [K, n_pad] block of the
+        global row-sharded scores, restored to FILE order (undoing any
+        shard-local ordered-partition permutation on the host)."""
+        s = np.asarray(self.grower.local_rows(self.scores))
+        if self._row_order is not None:
+            base = jax.process_index() * self.n_pad
+            ordl = np.asarray(self.grower.local_rows(self._row_order)) \
+                - base
+            out = np.empty_like(s)
+            out[:, ordl] = s
+            s = out
+        return s
+
     def _training_score(self):
+        if self._mh_fused:
+            s = self._mh_local_file_scores()[:, :self.num_data]
+            return s[0] if self.num_class == 1 else s
         s = self.scores
         inv = self._inverse_row_order()
         if inv is not None:
@@ -1746,10 +1963,15 @@ class GBDT:
         # store FILE order plus the row order itself, so a restored
         # booster reconstructs the exact permuted state and resumes
         # bit-for-bit
-        scores = np.asarray(self.scores)
-        inv = self._inverse_row_order()
-        if inv is not None:
-            scores = scores[:, np.asarray(inv)]
+        if self._mh_fused:
+            # multi-host fused: each process snapshots ITS file-order
+            # block (plus its local slice of the global row order below)
+            scores = self._mh_local_file_scores()
+        else:
+            scores = np.asarray(self.scores)
+            inv = self._inverse_row_order()
+            if inv is not None:
+                scores = scores[:, np.asarray(inv)]
         arrays = {
             "iter": np.int64(self.iter),
             "num_used_model": np.int64(self.num_used_model),
@@ -1760,7 +1982,9 @@ class GBDT:
             "num_trees": np.int64(len(self._models)),
         }
         if self._row_order is not None:
-            arrays["row_order"] = np.asarray(self._row_order)
+            arrays["row_order"] = (
+                np.asarray(self.grower.local_rows(self._row_order))
+                if self._mh_fused else np.asarray(self._row_order))
             arrays["trees_since_reorder"] = np.int64(
                 self._trees_since_reorder)
         # per-valid-set keys: metric counts can differ between valid sets,
@@ -1796,7 +2020,9 @@ class GBDT:
         z = np.load(path)
         self.iter = int(z["iter"])
         self._stopped = bool(z["stopped"])
-        self._dev_stopped = jnp.asarray(self._stopped)
+        self._dev_stopped = (
+            self.grower.replicate(np.asarray(self._stopped))
+            if self._mh_fused else jnp.asarray(self._stopped))
         # checkpointed per-row state is in FILE order; when the snapshot
         # carries an ordered-partition row order, rebuild the exact
         # permuted state (bins/scores/objective state) so training
@@ -1804,30 +2030,59 @@ class GBDT:
         bins = self.train_data.bins if self.train_data is not None else None
         if bins is not None and self.n_pad != self.num_data:
             bins = np.pad(bins, ((0, 0), (0, self.n_pad - self.num_data)))
+        ordl = None     # this process's local file-row permutation
         if "row_order" in z:
             order = np.asarray(z["row_order"])
-            self._row_order = jnp.asarray(order, dtype=jnp.int32)
             self._trees_since_reorder = int(z["trees_since_reorder"])
-            self.bins_dev = jnp.asarray(bins[:, order])
-            self._gstate_override = jax.tree_util.tree_map(
-                lambda a: jnp.take(a, self._row_order, axis=-1),
-                self.objective.grad_state()) \
-                if getattr(self.objective, "row_permutable", False) else None
-            z_scores = np.asarray(z["scores"])[:, order]
+            if self._mh_fused:
+                # the snapshot holds THIS process's slice of the global
+                # order (global positions); rebuild host-side in local
+                # coordinates, then assemble the global arrays
+                ordl = order - jax.process_index() * self.n_pad
+                self._row_order = self.grower.shard_rows(
+                    order.astype(np.int32), self.n_pad)
+                self.bins_dev = self.grower.shard_bins(bins[:, ordl])
+                gs_local = self.objective.make_permute_fn()(
+                    self.objective.grad_state(), jnp.asarray(ordl)) \
+                    if getattr(self.objective, "row_permutable", False) \
+                    else None
+                self._gstate_override = (
+                    None if gs_local is None else jax.tree_util.tree_map(
+                        lambda a: self.grower.shard_rows(np.asarray(a),
+                                                         self.n_pad),
+                        gs_local))
+                z_scores = np.asarray(z["scores"])[:, ordl]
+            else:
+                ordl = order
+                self._row_order = jnp.asarray(order, dtype=jnp.int32)
+                self.bins_dev = jnp.asarray(bins[:, order])
+                # rebuild the permuted grad_state through the objective's
+                # own permute fn (lambdarank remaps doc_idx; elementwise
+                # objectives take along the last axis)
+                self._gstate_override = self.objective.make_permute_fn()(
+                    self.objective.grad_state(), self._row_order) \
+                    if getattr(self.objective, "row_permutable", False) \
+                    else None
+                z_scores = np.asarray(z["scores"])[:, order]
             bag_restored = True
         else:
             if self._row_order is not None and bins is not None:
-                self.bins_dev = jnp.asarray(bins)
+                self.bins_dev = (self.grower.shard_bins(bins)
+                                 if self._mh_fused else jnp.asarray(bins))
             self._row_order = None
             self._trees_since_reorder = 0
             self._gstate_override = None
             z_scores = np.asarray(z["scores"])
             bag_restored = False
         self._inv_order = None
-        self.scores = jnp.asarray(z_scores)
-        if self.grower is not None and self.rows_sharded and not self._mh:
-            self.scores = jax.device_put(self.scores,
-                                         self.grower.row_sharding_2d())
+        if self._mh_fused:
+            self.scores = self.grower.shard_rows(z_scores, self.n_pad)
+        else:
+            self.scores = jnp.asarray(z_scores)
+            if self.grower is not None and self.rows_sharded \
+                    and not self._mh:
+                self.scores = jax.device_put(
+                    self.scores, self.grower.row_sharding_2d())
         self.bag_masks = [m.copy() for m in z["bag_masks"]]
         self._bag_dev = [None] * self.num_class
         self._bag_dev_packed = [None] * self.num_class
@@ -1835,8 +2090,10 @@ class GBDT:
         if bag_restored:
             # the fused-path device bag mask must follow the restored row
             # order (host bag_masks stay in file order like everything host)
-            self._bag_dev_packed[0] = jnp.asarray(
-                self.bag_masks[0][np.asarray(self._row_order)])
+            bag_ordered = self.bag_masks[0][ordl]
+            self._bag_dev_packed[0] = (
+                self.grower.shard_rows(bag_ordered, self.n_pad)
+                if self._mh_fused else jnp.asarray(bag_ordered))
         if "num_valid_sets" in z:
             nv = int(z["num_valid_sets"])
             self.best_iter = [[int(v) for v in z["best_iter_%d" % i]]
@@ -1846,8 +2103,9 @@ class GBDT:
         else:   # 0.1.0 checkpoints: one rectangular [sets, metrics] array
             self.best_iter = [list(map(int, r)) for r in z["best_iter"]]
             self.best_score = [list(map(float, r)) for r in z["best_score"]]
+        vput = (self.grower.replicate if self._mh_fused else jnp.asarray)
         for i in range(len(self.valid_scores)):
-            self.valid_scores[i] = jnp.asarray(z["valid_scores_%d" % i])
+            self.valid_scores[i] = vput(z["valid_scores_%d" % i])
         for name, rng in self._rng_streams():
             rng.set_state(z[name])
         self._models = []

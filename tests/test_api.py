@@ -351,6 +351,54 @@ def test_sparse_ingest_memory_is_nnz_bounded():
     assert peak < 150 * (1 << 20), peak
 
 
+def test_sparse_predict_is_nnz_bounded_and_matches_dense():
+    """VERDICT r4 #4: CSR/CSC prediction must never densify the whole
+    matrix — rows stream through a bounded [chunk, F] buffer — and the
+    output must equal the densified path exactly.  The wide shape here
+    would be ~2.4 GB dense f64; the chunked path stays under ~200 MB."""
+    import tracemalloc
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    # train on a small dense slice so the model uses real feature splits
+    n_tr, f = 2000, 10_000
+    x_tr = rng.randn(n_tr, 40)
+    y = (x_tr[:, 0] + 0.5 * x_tr[:, 1] > 0).astype(float)
+    pad = sp.csr_matrix((n_tr, f - 40))
+    ds = lgb.Dataset(sp.hstack([sp.csr_matrix(x_tr), pad]).tocsr(),
+                     label=y, params={"max_bin": 63, "num_leaves": 7,
+                                      "min_data_in_leaf": 20})
+    bst = lgb.train({"objective": "binary", "max_bin": 63,
+                     "num_leaves": 7, "min_data_in_leaf": 20,
+                     "metric": ""}, ds, num_boost_round=3,
+                    verbose_eval=False)
+
+    # the VERDICT r4 #4 shape: 100k x 10k at 0.1% density — the
+    # densified matrix would be 8 GB of f64
+    n, nnz = 100_000, 1_000_000
+    cols = rng.randint(0, 40, nnz)   # nonzeros only in used features
+    mat = sp.csr_matrix(
+        (rng.randn(nnz), (rng.randint(0, n, nnz), cols)), shape=(n, f))
+    tracemalloc.start()
+    got = bst.predict(mat)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert got.shape == (n,)
+    assert peak < 300 * (1 << 20), peak
+    # the chunked sparse path must agree with full densification
+    # (on a slice — densifying all 100k rows is the cliff being removed)
+    want = bst.predict(np.asarray(mat[:5000].todense()))
+    np.testing.assert_array_equal(got[:5000], want)
+    # CSC input routes through the same O(nnz) conversion
+    got_csc = bst.predict(mat[:5000].tocsc())
+    np.testing.assert_array_equal(got_csc, want)
+    # pred_leaf chunk-concatenates on the row axis too
+    np.testing.assert_array_equal(
+        bst.predict(mat[:300], pred_leaf=True),
+        bst.predict(np.asarray(mat[:300].todense()), pred_leaf=True))
+
+
 def test_matrix_bin_sample_rng_matches_file_path():
     """In-memory matrix construction samples bin rows with the
     reference's mt19937 Random::Sample (VERDICT r3 missing #2): with

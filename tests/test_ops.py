@@ -547,6 +547,43 @@ def test_ordered_mode_bagged_matches_default():
         np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
 
 
+def test_ordered_mode_lambdarank_matches_default():
+    """Round 5: lambdarank is row_permutable — its row_slot map rides
+    the ordered-partition permutation and doc_idx remaps through the
+    inverse (objectives.LambdarankNDCG.make_permute_fn), so ranking
+    gets the leaf-clustered block sweeps every other family has.  Trees
+    must match the never-reordered run exactly."""
+    import lightgbm_tpu as lgb
+    n = 8192 * 2
+    rng = np.random.RandomState(7)
+    x = rng.randn(n, 6).astype(np.float32)
+    rel = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.5 * rng.randn(n)
+    y = np.clip(np.round(rel + 1.5), 0, 4).astype(np.float32)
+    group = np.full(n // 16, 16, dtype=np.int32)
+    common = {"objective": "lambdarank", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
+              "hist_impl": "pallas", "hist_dtype": "float32"}
+
+    def train(ordered):
+        ds = lgb.Dataset(x, label=y, group=group)
+        return lgb.train({**common, "hist_ordered": ordered,
+                          "hist_reorder_every": 2}, ds,
+                         num_boost_round=5, verbose_eval=False)
+
+    b_off = train("off")
+    b_on = train("auto")
+    assert b_on._gbdt._row_order is not None, \
+        "permutable lambdarank must have re-sorted rows"
+    for t1, t2 in zip(b_off._gbdt.models, b_on._gbdt.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
+        np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
+    xt = rng.randn(300, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(b_off.predict(xt)),
+                               np.asarray(b_on.predict(xt)), atol=2e-5)
+
+
 def test_dart_banked_matches_host_path_long_drops():
     """The banked DART path must track the host-tree path through long
     drop histories at f32: tree STRUCTURE stays identical, and model
