@@ -1,0 +1,72 @@
+"""Worker for the multi-host ORDERED-partition fused test
+(test_parallel.py::test_multihost_ordered_fused_matches_unordered).
+
+Usage: python mh_ordered_worker.py <rank> <nproc> <port> <data> <out>
+                                   <hist_ordered>
+
+Each worker owns 4 virtual CPU devices, joins jax.distributed, loads its
+lottery row shard, and trains tree_learner=data through the MULTI-HOST
+fused shard_map step with the Pallas (interpret-mode) histogram kernel —
+hist_ordered=auto exercises the round-5 mh reorder path: global-position
+row order, shard-local re-sorts, permuted global bag masks and gradient
+state.  Bagging + feature_fraction compose on top.
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out, ordered = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "data", "num_leaves": "15",
+    "min_data_in_leaf": "20", "hist_impl": "pallas",
+    "hist_dtype": "float32", "hist_ordered": ordered,
+    "hist_reorder_every": "2", "bagging_fraction": "0.8",
+    "bagging_freq": "3", "feature_fraction": "0.8", "metric": "",
+    "is_save_binary_file": "false"})
+ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+booster = create_boosting(cfg, ds, obj)
+assert booster._mh_fused and booster._can_fuse(), "must take mh fused path"
+if ordered != "off":
+    assert booster.hist_ranged, "ordered mode must be active"
+for _ in range(3):
+    booster.train_one_iter(None, None, False)
+if ordered != "off":
+    assert booster._row_order is not None, "mh re-sort must have run"
+
+# exact-state checkpoint/resume under the multi-host fused path: each
+# rank snapshots ITS file-order block + its slice of the global row
+# order; a fresh booster restored from it must continue bit-for-bit
+ckpt = out + ".rank%d.ckpt" % rank
+booster.save_checkpoint(ckpt)
+resumed = create_boosting(cfg, ds, obj)
+resumed.load_checkpoint(ckpt)
+for b in (booster, resumed):
+    for _ in range(3):
+        b.train_one_iter(None, None, False)
+ma = "".join(t.to_string() for t in booster.models)
+mb = "".join(t.to_string() for t in resumed.models)
+assert ma == mb, "mh checkpoint resume diverged from uninterrupted run"
+
+booster.save_model_to_file(-1, True, out)
+print("worker %d done (%s): %d trees" % (rank, ordered,
+                                         len(booster.models)))

@@ -527,6 +527,68 @@ def test_multihost_two_process_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_ordered_fused_matches_unordered(tmp_path):
+    """Round-5 multi-host ORDERED partition: the 2-process fused run
+    with shard-local re-sorts (global-position row order, permuted
+    global bag masks + gradient state) must grow the same tree
+    STRUCTURES as the same 2-process cluster with hist_ordered=off,
+    and both ranks must save identical models.  Each worker also
+    snapshots an exact-state checkpoint mid-training and verifies a
+    restored booster continues bit-for-bit (the mh-fused save/load
+    path: per-rank file-order blocks + row-order slices)."""
+    import os
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(8)
+    n, ncol = 4096, 6
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(int)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "mh_ordered_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def run_cluster(ordered):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+        s.close()
+        outs = [str(tmp_path / ("model_%s_%d.txt" % (ordered, r)))
+                for r in range(2)]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", port, str(data),
+             outs[r], ordered],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(2)]
+        logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+        for r, p in enumerate(procs):
+            assert p.returncode == 0, "worker %d (%s) failed:\n%s" % (
+                r, ordered, logs[r])
+        m0, m1 = open(outs[0]).read(), open(outs[1]).read()
+        assert m0 == m1, "ranks saved different models (%s)" % ordered
+        return m0
+
+    m_off = run_cluster("off")
+    m_on = run_cluster("auto")
+    off_trees = m_off.split("Tree=")[1:]
+    on_trees = m_on.split("Tree=")[1:]
+    assert len(off_trees) == len(on_trees) == 6
+    for i, (a, b) in enumerate(zip(off_trees, on_trees)):
+        da = {ln.split("=")[0]: ln.split("=", 1)[1]
+              for ln in a.splitlines()[1:] if "=" in ln}
+        db = {ln.split("=")[0]: ln.split("=", 1)[1]
+              for ln in b.splitlines()[1:] if "=" in ln}
+        for key in ("num_leaves", "split_feature", "threshold"):
+            assert da[key] == db[key], "tree %d %s differs" % (i, key)
+
+
+@pytest.mark.slow
 def test_multihost_matches_reference_socket_cluster(tmp_path):
     """THE distributed parity test: our 2-process jax.distributed run must
     reproduce the reference binary's 2-machine SOCKET cluster
