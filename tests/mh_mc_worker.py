@@ -1,0 +1,57 @@
+"""Worker for the multi-host MULTICLASS fused test
+(test_parallel.py::test_multihost_multiclass_fused_matches_general).
+
+Usage: python mh_mc_worker.py <rank> <nproc> <port> <data> <out> <mode>
+
+mode=fused trains through the round-5 multi-host multiclass fused step
+(class-wise scan under shard_map over the cross-process mesh);
+mode=general forces the per-class host-loop path the fused step
+replaced — models must match exactly (hist_dtype=float64).
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out, mode = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models import gbdt as gbdt_mod  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+if mode == "general":
+    # the pre-round-5 path: per-class trees with host grad assembly
+    gbdt_mod.GBDT._can_fuse_multi = lambda self: False
+
+cfg = Config.from_params({
+    "objective": "multiclass", "num_class": "3", "tree_learner": "data",
+    "num_leaves": "8", "min_data_in_leaf": "5",
+    "min_sum_hessian_in_leaf": "1", "hist_dtype": "float64",
+    "metric": "", "is_save_binary_file": "false"})
+ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+booster = gbdt_mod.create_boosting(cfg, ds, obj)
+if mode == "fused":
+    assert booster._mh_fused and booster._can_fuse_multi(), \
+        "multi-host multiclass must take the fused sharded path"
+else:
+    assert not booster._can_fuse_multi()
+for _ in range(3):
+    booster.train_one_iter(None, None, False)
+booster.save_model_to_file(-1, True, out)
+print("worker %d done (%s): %d trees" % (rank, mode,
+                                         len(booster.models)))

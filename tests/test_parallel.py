@@ -589,6 +589,58 @@ def test_multihost_ordered_fused_matches_unordered(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_multiclass_fused_matches_general(tmp_path):
+    """Round-5 multi-host MULTICLASS fusion: the class-wise-scan
+    shard_map step over a 2-process mesh must produce byte-identical
+    models to the general per-class path it replaced (hist_dtype
+    float64), and both ranks must agree."""
+    import os
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(9)
+    n, ncol, k = 1200, 5, 3
+    x = rng.randn(n, ncol)
+    raw = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.3 * rng.randn(n)
+    edges = np.quantile(raw, [1.0 / k, 2.0 / k])
+    y = np.digitize(raw, edges)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+    worker = os.path.join(os.path.dirname(__file__), "mh_mc_worker.py")
+    env = {k2: v for k2, v in os.environ.items()
+           if k2 not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def run_cluster(mode):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+        s.close()
+        outs = [str(tmp_path / ("model_%s_%d.txt" % (mode, r)))
+                for r in range(2)]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", port, str(data),
+             outs[r], mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(2)]
+        logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+        for r, p in enumerate(procs):
+            assert p.returncode == 0, "worker %d (%s) failed:\n%s" % (
+                r, mode, logs[r])
+        m0, m1 = open(outs[0]).read(), open(outs[1]).read()
+        assert m0 == m1, "ranks saved different models (%s)" % mode
+        return m0
+
+    m_fused = run_cluster("fused")
+    m_general = run_cluster("general")
+    assert m_fused.count("Tree=") == 9   # 3 iterations x 3 classes
+    assert m_fused == m_general, \
+        "fused multi-host multiclass diverged from the general path"
+
+
+@pytest.mark.slow
 def test_multihost_matches_reference_socket_cluster(tmp_path):
     """THE distributed parity test: our 2-process jax.distributed run must
     reproduce the reference binary's 2-machine SOCKET cluster
