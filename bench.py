@@ -21,6 +21,13 @@ Prints ONE JSON line:
   {"metric": "train_steady_100trees_1Mx28", "value": <our seconds>,
    "unit": "s", "vs_baseline": <ref_seconds / our_seconds>, ...extras}
 vs_baseline > 1 means we beat the reference.
+
+Timing conventions (symmetric across every family): `*_wall_s` is the
+raw loop wall-clock including transient remote-tunnel stalls;
+`*_train_s` is the chunked-steady extrapolation min(chunk) * chunks.
+The emitted `vs_baseline_timing` map states which convention each
+`vs_baseline` ratio uses (headline: wall; per-family ratios: steady;
+predict: wall).
 """
 
 import json
@@ -179,7 +186,14 @@ def _rank_params():
     }
 
 
-def run_ours_rank():
+def _run_rank_workload(prefix, extra_params=None, force_general=False):
+    """One lambdarank training measurement.  prefix names the emitted
+    keys (<prefix>_train_s steady, <prefix>_wall_s raw).  extra_params
+    overlays _rank_params (e.g. tree_learner=data for the fused
+    query-sharded step).  force_general=False keeps the objective's own
+    routing; True clears row_shardable so tree_learner=data takes the
+    pre-fusion general per-tree path — the fused-vs-general speedup
+    pair for BASELINE.md."""
     import jax
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.binning import find_bins
@@ -188,7 +202,7 @@ def run_ours_rank():
     from lightgbm_tpu.objectives import create_objective
 
     x, y, qb = make_rank_data()
-    cfg = Config.from_params(_rank_params())
+    cfg = Config.from_params({**_rank_params(), **(extra_params or {})})
     rng = np.random.RandomState(SEED)
     sample = rng.choice(RANK_DOCS, min(50_000, RANK_DOCS), replace=False)
     mappers = find_bins(x[sample], len(sample), cfg.max_bin)
@@ -205,6 +219,8 @@ def run_ours_rank():
     def fresh():
         obj = create_objective(cfg)
         obj.init(ds.metadata, ds.num_data)
+        if force_general:
+            obj.row_shardable = False
         return create_boosting(cfg, ds, obj)
 
     # TWO warm-up iterations, same reason as the binary family
@@ -233,8 +249,12 @@ def run_ours_rank():
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
-    return {"rank_train_s": min(chunk_s) * chunks,
-            "rank_train_total_s": time.time() - t_all}
+    return {prefix + "_train_s": min(chunk_s) * chunks,
+            prefix + "_wall_s": time.time() - t_all}
+
+
+def run_ours_rank():
+    return _run_rank_workload("rank")
 
 
 def run_reference_rank():
@@ -321,7 +341,7 @@ def run_ours_bagged():
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
     return {"bagged_train_s": min(chunk_s) * chunks,
-            "bagged_train_total_s": time.time() - t_all}
+            "bagged_wall_s": time.time() - t_all}
 
 
 def run_reference_bagged():
@@ -511,6 +531,7 @@ def _run_ours_workload(params, x, y, num_trees, field, warm_iters=1):
     chunks = 4 if num_trees % 4 == 0 else 1
     per = num_trees // chunks
     chunk_s = []
+    t_all = time.time()
     for _ in range(chunks):
         t0 = time.time()
         for _ in range(per):
@@ -521,6 +542,8 @@ def _run_ours_workload(params, x, y, num_trees, field, warm_iters=1):
     # per-family warm-up wall (compile or persistent-cache load) —
     # VERDICT r4 weak #5 asks for compile cost visibility per family
     return {field: min(chunk_s) * chunks,
+            field.replace("_train_s", "_wall_s"):
+                round(time.time() - t_all, 3),
             field.replace("_train_s", "_compile_s"): round(compile_s, 3)}
 
 
@@ -596,15 +619,42 @@ def main():
     if os.environ.get("BENCH_RANK", "1") != "0":
         try:
             r = run_ours_rank()
-            rr = run_reference_rank()
             extras = {
                 "rank_train_s": round(r["rank_train_s"], 3),
+                "rank_wall_s": round(r["rank_wall_s"], 3),
+            }
+            # the tentpole's tree_learner=data rank line: the fused
+            # query-sharded step vs the pre-fusion general per-tree
+            # path on the SAME device mesh (the fused-vs-general
+            # speedup recorded in BASELINE.md)
+            rd = _run_rank_workload("rank_data",
+                                    {"tree_learner": "data"})
+            extras.update({
+                "rank_data_train_s": round(rd["rank_data_train_s"], 3),
+                "rank_data_wall_s": round(rd["rank_data_wall_s"], 3)})
+            try:
+                rg = _run_rank_workload(
+                    "rank_data_general", {"tree_learner": "data"},
+                    force_general=True)
+                extras.update({
+                    "rank_data_general_train_s": round(
+                        rg["rank_data_general_train_s"], 3),
+                    "rank_data_fused_vs_general": round(
+                        rg["rank_data_general_train_s"]
+                        / rd["rank_data_train_s"], 4)})
+            except Exception as e:
+                extras["rank_data_general_error"] = str(e)[:200]
+            rr = run_reference_rank()
+            extras.update({
                 "ref_rank_train_s": rr["ref_rank_train_s"],
                 "rank_vs_baseline": round(
                     rr["ref_rank_train_s"] / r["rank_train_s"], 4),
-            }
+                "rank_data_vs_baseline": round(
+                    rr["ref_rank_train_s"]
+                    / rd["rank_data_train_s"], 4),
+            })
         except Exception as e:
-            extras = {"rank_error": str(e)[:200]}
+            extras["rank_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_BAGGED", "1") != "0":
         try:
@@ -612,6 +662,7 @@ def main():
             br = run_reference_bagged()
             extras.update({
                 "bagged_train_s": round(bo["bagged_train_s"], 3),
+                "bagged_wall_s": round(bo["bagged_wall_s"], 3),
                 "ref_bagged_train_s": br["ref_bagged_train_s"],
                 "bagged_vs_baseline": round(
                     br["ref_bagged_train_s"] / bo["bagged_train_s"], 4),
@@ -634,6 +685,7 @@ def main():
                 extras.update({
                     "regression_train_s": round(
                         ro["regression_train_s"], 3),
+                    "regression_wall_s": ro.get("regression_wall_s"),
                     "regression_compile_s": ro.get("regression_compile_s"),
                     "ref_regression_train_s":
                         rr["ref_regression_train_s"],
@@ -647,6 +699,7 @@ def main():
                 extras.update({
                     "multiclass_train_s": round(
                         mo["multiclass_train_s"], 3),
+                    "multiclass_wall_s": mo.get("multiclass_wall_s"),
                     "multiclass_compile_s": mo.get("multiclass_compile_s"),
                     "ref_multiclass_train_s":
                         mr["ref_multiclass_train_s"],
@@ -660,6 +713,7 @@ def main():
             do, dr = run_dart_pair()
             extras.update({
                 "dart_train_s": round(do["dart_train_s"], 3),
+                "dart_wall_s": do.get("dart_wall_s"),
                 "dart_compile_s": do.get("dart_compile_s"),
                 "ref_dart_train_s": dr["ref_dart_train_s"],
                 "dart_vs_baseline": round(
@@ -679,6 +733,18 @@ def main():
     # transient tunnel stalls and the post-warm-up residual); the
     # steady-state extrapolation min(chunk)*4 is reported alongside as
     # vs_baseline_steady (ADVICE r1: wall is the honest primary).
+    # SYMMETRIC reporting (VERDICT r5 item 5): every family emits BOTH
+    # its chunked-steady `*_train_s` and raw `*_wall_s`; the map below
+    # states which convention each vs_baseline ratio uses, so BASELINE
+    # readers never have to guess.
+    conventions = {"vs_baseline": "wall", "vs_baseline_steady": "steady"}
+    for k in extras:
+        if k.endswith("_vs_baseline") or k.endswith("_vs_general"):
+            conventions[k] = "steady"
+    if "predict_vs_baseline" in extras:
+        # file-to-file predict has no chunked loop; both sides are
+        # single-shot walls (ours best-of-2 against tunnel stalls)
+        conventions["predict_vs_baseline"] = "wall"
     print(json.dumps({
         "metric": "train_100trees_1Mx28",
         "value": round(ours["train_total_s"], 3),
@@ -693,6 +759,7 @@ def main():
         "ncpu": os.cpu_count(),
         "trees_per_s": round(NUM_TREES / ours["train_s"], 3),
         **extras,
+        "vs_baseline_timing": conventions,
     }))
 
 

@@ -537,7 +537,12 @@ class Booster:
             outs.append(self.predict(blk, raw_score, pred_leaf,
                                      num_iteration))
         if not outs:
-            return np.zeros(0)
+            # 0-row matrices produce mode-SHAPED empty output, exactly
+            # like the dense path: [0] binary/regression, [0, K]
+            # multiclass, [0, T] pred_leaf — callers indexing the class
+            # axis must not see a sparse/dense shape mismatch
+            return self.predict(np.zeros((0, f)), raw_score, pred_leaf,
+                                num_iteration)
         return np.concatenate(outs, axis=0)
 
     # -- model io (LGBM_BoosterSaveModel / LoadModelFromString) ---------

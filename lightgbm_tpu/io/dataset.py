@@ -728,14 +728,50 @@ def _save_binary_cache(ds: Dataset, filename: str, config: Config,
     writes ITS partition to a rank-tagged cache (plus a `.rows.npz`
     sidecar with the global row indices and count, our extension — the
     reference format has no such fields), so a multi-machine re-run
-    skips both the text parse AND the lottery replay.  Single-machine
-    keeps the reference's global `<file>.bin`."""
+    skips both the text parse AND the lottery replay.  The sidecar also
+    records the lottery's data_random_seed and granularity (query vs
+    row) so a later run under a different seed (or with the .query
+    sidecar added/removed) falls back to text/global loading instead of
+    silently reusing a stale — and potentially cluster-inconsistent —
+    partition.  Single-machine keeps the reference's global
+    `<file>.bin`."""
     path = _rank_cache_path(filename, rank, num_shards)
     _save_binary(ds, path, config.num_class)
     if num_shards > 1 and ds.local_rows is not None:
         with open(path + ".rows.npz", "wb") as f:
             np.savez(f, rows=ds.local_rows,
-                     n_global=np.int64(n_global))
+                     n_global=np.int64(n_global),
+                     seed=np.int64(config.data_random_seed),
+                     query_lottery=np.int64(
+                         ds.metadata.query_boundaries is not None))
+
+
+def _rank_cache_matches(cache: str, filename: str,
+                        config: Config) -> bool:
+    """True when a rank-tagged cache's `.rows.npz` sidecar records the
+    SAME lottery the current run would draw: data_random_seed and
+    granularity (query vs row — whether a `.query` sidecar drove
+    whole-query draws).  Anything else — a missing sidecar, an older
+    sidecar without these fields, a different seed, a granularity flip —
+    counts as a mismatch: a stale partition must never load silently,
+    because ranks whose caches were deleted would re-lottery under the
+    NEW stream and the cluster's row sets would no longer partition."""
+    side = cache + ".rows.npz"
+    if not os.path.isfile(side):
+        return False
+    try:
+        with np.load(side) as z:
+            if "seed" not in z.files or "query_lottery" not in z.files:
+                return False
+            if int(z["seed"]) != int(config.data_random_seed):
+                return False
+            want_query = (os.path.isfile(filename + ".query")
+                          or bool(config.group_column.strip()))
+            return bool(int(z["query_lottery"])) == want_query
+    except Exception:
+        # any unreadable sidecar (truncated write from a killed run
+        # raises zipfile.BadZipFile, not OSError) = mismatch
+        return False
 
 
 def load_dataset(filename: str, config: Config,
@@ -759,6 +795,19 @@ def load_dataset(filename: str, config: Config,
     cache = _rank_cache_path(filename, rank, num_shards)
     global_cache = filename + ".bin"
     shard_from_global = False
+    if (reference is None and config.enable_load_from_binary_file
+            and num_shards > 1 and cache != global_cache
+            and os.path.isfile(cache)
+            and not _rank_cache_matches(cache, filename, config)):
+        # stale rank-tagged cache: its recorded lottery (seed /
+        # granularity) differs from the one this run would draw —
+        # ignore it and fall back to the global cache or text
+        log.warning(
+            "Ignoring rank-tagged binary cache %s: its lottery "
+            "(data_random_seed / query granularity) does not match the "
+            "current config" % cache)
+        cache = global_cache
+        shard_from_global = not config.is_pre_partition
     if (reference is None and config.enable_load_from_binary_file
             and not os.path.isfile(cache) and num_shards > 1
             and os.path.isfile(global_cache)):

@@ -458,7 +458,7 @@ def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
     replicated, and the joint-leaf-key re-sort stays SHARD-LOCAL."""
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
     body = _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder,
                                   permute_state)
@@ -477,8 +477,8 @@ def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
         in_specs = common_in
         out_specs = (row2, vrep, rep, rep, rep)
         donate = (0, 1)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
     return jax.jit(fn, donate_argnums=donate)
 
 
@@ -497,7 +497,7 @@ def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
     local and reassembled per tree (models/gbdt.py _train_tree)."""
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.mesh import DATA_AXIS, shard_map
 
     body = (_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
                                      permute_state) if reorder
@@ -516,8 +516,8 @@ def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
         in_specs = common_in + (rep,)
         out_specs = (row2, vrep, rep, rep, rep)
         donate = (0, 1)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
     return jax.jit(fn, donate_argnums=donate)
 
 
@@ -615,6 +615,7 @@ class GBDT:
         self.rows_sharded = False
         self._mh = False
         self._feat_mh = False
+        row_unit_base = row_unit   # per-shard row alignment (Pallas block)
         if config.tree_learner in ("data", "voting"):
             from ..parallel.mesh import ShardedGrower, make_mesh
             mesh = make_mesh(config.num_shards)
@@ -667,22 +668,6 @@ class GBDT:
             if k <= max(config.num_leaves, 2):
                 self.hist_slots = k
 
-        n_for_pad = self._n_pad_base if self._mh else n
-        self.n_pad = ((n_for_pad + row_unit - 1) // row_unit) * row_unit
-
-        # small-leaf row compaction (ops/grow.py hist_small): serial
-        # learner only, f32 only — the f64 parity configuration keeps the
-        # full-sweep accumulation grouping the golden logs pin.
-        # EXPERIMENTAL opt-in: on current TPUs the XLA gather/scatter row
-        # selection costs more per split than the near-peak-MXU full
-        # sweep it avoids (measured 4.5x slower at 1Mx28 — BASELINE.md)
-        self.hist_compact = 0
-        if (config.hist_compact == "on" and self.grower is None
-                and self.dtype == jnp.float32):
-            half = max(self.n_pad // 2, 1)
-            self.hist_compact = ((half + row_unit - 1)
-                                 // row_unit) * row_unit
-
         # tree_learner=data can run the fused step (and the ordered
         # partition below) under shard_map: every per-row array shards
         # along the data axis and re-sorts stay shard-local
@@ -708,6 +693,53 @@ class GBDT:
                                and (not self._mh or mh_fusible))
         self._mh_fused = self._mh and self._fused_sharded
 
+        # query-granular row layout: an objective whose grad_state is
+        # NOT per-row (lambdarank's query blocks) provides its own row
+        # placement for the fused sharded step — shard s's contiguous
+        # device block holds whole queries padded to a common capacity,
+        # so each shard computes its queries' pairwise lambdas locally
+        # and only histograms cross shards (the reference's rank + data-
+        # parallel locality, data_parallel_tree_learner.cpp:124-187).
+        # None for elementwise objectives (default contiguous blocks).
+        self._shard_layout = None
+        self._layout_active = False
+        if (self._fused_sharded and config.tree_learner == "data"
+                and objective is not None
+                and getattr(objective, "jax_traceable", False)):
+            # capacity alignment: the Pallas row block, times the
+            # process count under multi-host so every process's local
+            # block (cap * local_shards) divides over the GLOBAL device
+            # count (shard_bins/_put_sharded equal-block requirement)
+            align = row_unit_base * (jax.process_count() if self._mh
+                                     else 1)
+            self._shard_layout = objective.shard_layout(
+                self.grower.local_shard_count(), align, self._mh)
+            self._layout_active = self._shard_layout is not None
+        self._gstate_specs = None
+
+        if self._shard_layout is not None:
+            # local padded rows = per-shard capacity x local shards;
+            # every process agrees on the capacity (synced in the
+            # layout builder), so multi-host blocks stay equal
+            self.n_pad = self._shard_layout.n_pad
+        else:
+            n_for_pad = self._n_pad_base if self._mh else n
+            self.n_pad = ((n_for_pad + row_unit - 1) // row_unit) \
+                * row_unit
+
+        # small-leaf row compaction (ops/grow.py hist_small): serial
+        # learner only, f32 only — the f64 parity configuration keeps the
+        # full-sweep accumulation grouping the golden logs pin.
+        # EXPERIMENTAL opt-in: on current TPUs the XLA gather/scatter row
+        # selection costs more per split than the near-peak-MXU full
+        # sweep it avoids (measured 4.5x slower at 1Mx28 — BASELINE.md)
+        self.hist_compact = 0
+        if (config.hist_compact == "on" and self.grower is None
+                and self.dtype == jnp.float32):
+            half = max(self.n_pad // 2, 1)
+            self.hist_compact = ((half + row_unit - 1)
+                                 // row_unit) * row_unit
+
         # ordered-partition growth (pallas learner, serial or single-host
         # data-parallel): block-list sweeps are always on (bit-identical
         # to full sweeps for a fixed row order — empty blocks contribute
@@ -731,10 +763,16 @@ class GBDT:
         self._trees_since_reorder = 0
 
         bins = train_data.bins
-        if self.n_pad != n:
-            bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
         self.scores = self._init_scores(train_data, n)
-        if self.n_pad != n:
+        if self._shard_layout is not None:
+            # query-granular layout: file rows scatter into per-shard
+            # blocks; gap rows (like trailing pad rows) stay permanently
+            # out-of-bag and their scores are never read
+            bins = self._shard_layout.place(bins)
+            self.scores = jnp.asarray(
+                self._shard_layout.place(np.asarray(self.scores)))
+        elif self.n_pad != n:
+            bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
             self.scores = jnp.pad(self.scores,
                                   ((0, 0), (0, self.n_pad - n)))
         if self.grower is not None:
@@ -891,6 +929,7 @@ class GBDT:
         if gradients is None and self._can_fuse():
             # fully-fused iteration: gradients -> grow -> score updates ->
             # tree packing in ONE dispatch with donated score buffers
+            self._ensure_layout()
             self._bagging(self.iter, 0)
             fmask = self._feature_mask(0)
             fmask_dev = (self.grower.replicate(fmask) if self._mh_fused
@@ -985,9 +1024,11 @@ class GBDT:
         surgery + varying shrinkage), custom gradients, multiclass,
         multi-host and voting/feature growers take the general path.
         The sharded variant additionally needs a row_shardable objective
-        (its grad_state shards along the data axis; lambdarank's
-        query-block state cannot, so rank + tree_learner=data grows
-        through the general path)."""
+        — elementwise grad_state shards along the data axis, and
+        lambdarank's query-block state shards query-granularly through
+        its own RowShardLayout (shard_layout/build_sharded_state), so
+        rank runs the fused sharded step too; rank_impl=native keeps
+        the general path (host gradients)."""
         return (type(self) is GBDT and self.num_class == 1
                 and (self.grower is None
                      or (self._fused_sharded
@@ -1116,9 +1157,19 @@ class GBDT:
         """Gradient state for the fused dispatch: the cached permuted/
         global override when present, else the objective's own arrays —
         assembled ONCE into global row-sharded arrays under multi-host
-        (the reorder steps keep the cached state permuted)."""
+        (the reorder steps keep the cached state permuted).  Under the
+        query-granular layout the objective builds its shard-major state
+        instead (lambdarank: per-shard query blocks with shard-local doc
+        indices), placed once via put_spec."""
         gstate = self._gstate_override
         if gstate is None:
+            if self._layout_active:
+                host, specs = self._build_sharded_gstate_host()
+                self._gstate_specs = specs
+                gstate = tuple(self.grower.put_spec(a, sp)
+                               for a, sp in zip(host, specs))
+                self._gstate_override = gstate
+                return gstate
             gstate = self.objective.grad_state()
             if self._mh_fused:
                 gstate = jax.tree_util.tree_map(
@@ -1126,6 +1177,17 @@ class GBDT:
                                                      self.n_pad), gstate)
                 self._gstate_override = gstate
         return gstate
+
+    def _build_sharded_gstate_host(self):
+        """(host_leaves, specs) of the objective's query-sharded state
+        (multi-host syncs the block shapes so every process's put
+        agrees)."""
+        sync = None
+        if self._mh_fused:
+            from ..parallel.dist import sync_max_ints
+            sync = sync_max_ints
+        return self.objective.build_sharded_state(self._shard_layout,
+                                                  sync=sync)
 
     def _identity_order_dev(self):
         """Initial ordered-partition row order: global POSITIONS
@@ -1160,9 +1222,14 @@ class GBDT:
                 # with the reference's per-machine bagging) assembles
                 # into the global row-sharded mask; the order permute is
                 # shard-local by construction (ShardedGrower.permute_rows)
-                m = (self.grower.shard_rows(self.bag_masks[cls],
-                                            self.n_pad)
-                     if self._mh_fused else jnp.asarray(self.bag_masks[cls]))
+                m_host = self.bag_masks[cls]
+                if self._layout_active:
+                    # query-granular layout: file-order draw scatters
+                    # into the per-shard blocks; gap rows stay False
+                    m_host = self._shard_layout.place(
+                        m_host[:self.num_data], fill=False)
+                m = (self.grower.shard_rows(m_host, self.n_pad)
+                     if self._mh_fused else jnp.asarray(m_host))
                 if self._row_order is not None:
                     m = self.grower.permute_rows(m, self._row_order)
                 self._bag_dev_packed[cls] = m
@@ -1204,9 +1271,13 @@ class GBDT:
                                hist_agg=cfg.hist_agg,
                                num_shards=self.grower.num_shards,
                                voting_top_k=0)
-                gspecs = jax.tree_util.tree_map(
-                    lambda a: P(*([None] * (np.ndim(a) - 1)
-                                  + [DATA_AXIS])), gstate)
+                # query-sharded objectives carry their own specs (the
+                # query-block leaves shard on their LEADING axis);
+                # elementwise state shards on its last (row) axis
+                gspecs = (self._gstate_specs if self._layout_active
+                          else jax.tree_util.tree_map(
+                              lambda a: P(*([None] * (np.ndim(a) - 1)
+                                            + [DATA_AXIS])), gstate))
                 return _make_fused_step_sharded(
                     self.objective.make_grad_fn(), grow_kw, lr,
                     self.dtype, self.grower.mesh,
@@ -1458,15 +1529,59 @@ class GBDT:
             self._inv_order = jnp.argsort(self._row_order)
         return self._inv_order
 
+    def _ensure_layout(self) -> None:
+        """(Re-)place per-row state into the query-granular layout when
+        the fused path resumes after a general-path excursion (custom
+        gradients restore file order via _restore_row_order).  The
+        initial placement happens in __init__; multi-host never comes
+        back (the fused->general fallback is one-way there)."""
+        if self._shard_layout is None or self._layout_active:
+            return
+        lay = self._shard_layout
+        host = np.asarray(self.scores)[:, :self.num_data]
+        self.scores = jnp.asarray(lay.place(host))
+        if self.rows_sharded and not self._mh:
+            self.scores = jax.device_put(self.scores,
+                                         self.grower.row_sharding_2d())
+        self.bins_dev = self.grower.shard_bins(
+            lay.place(self.train_data.bins))
+        self._bag_dev = [None] * self.num_class
+        self._bag_dev_packed = [None] * self.num_class
+        self._bag_stacked = None
+        self._gstate_override = None
+        self._layout_active = True
+
+    def _layout_pos_dev(self):
+        """Cached device copy of the layout's file-row -> padded-
+        position map (reads scores back to file order without a host
+        round trip)."""
+        if getattr(self, "_layout_pos", None) is None:
+            self._layout_pos = jnp.asarray(self._shard_layout.pos)
+        return self._layout_pos
+
+    def _unplace_host(self, arr: np.ndarray) -> np.ndarray:
+        """Layout space -> file order + trailing pad (host, [.., n_pad])."""
+        out = np.zeros_like(arr)
+        filed = self._shard_layout.unplace(arr)
+        out[..., :filed.shape[-1]] = filed
+        return out
+
     def _restore_row_order(self) -> None:
         """Return all per-row state to FILE order (leaving the fused
-        ordered-partition path: custom gradients, objective swaps)."""
+        ordered-partition path and/or the query-granular layout: custom
+        gradients, objective swaps)."""
         if self._mh_fused:
             # leaving the multi-host fused path (custom gradients): pull
             # this process's file-order block local and fall back to the
             # general per-tree path for the REST of training — one-way,
             # because the general path keeps scores process-local and
-            # cannot hand them back to the global fused dispatch
+            # cannot hand them back to the global fused dispatch.
+            # Materialize pending fused trees FIRST: their packed buffers
+            # are REPLICATED global arrays, and a later flush would stack
+            # them with the general path's process-local buffers
+            # (incompatible devices); _stopped propagates via the next
+            # flush either way.
+            self._flush_pending()
             self.scores = jnp.asarray(self._mh_local_file_scores())
             self.valid_scores = [
                 jnp.asarray(np.asarray(v.addressable_data(0)))
@@ -1476,6 +1591,20 @@ class GBDT:
                 for v in self.valid_bins_dev]
             self._dev_stopped = jnp.asarray(
                 bool(np.asarray(self._dev_stopped.addressable_data(0))))
+            if self._row_order is not None or self._layout_active:
+                # rebuild the global sharded bins from FILE order: the
+                # general mh path keeps using self.bins_dev, which the
+                # ordered-partition re-sorts (and the query layout)
+                # left permuted — training later trees on permuted bins
+                # against file-order gradients would silently corrupt
+                # every subsequent tree
+                bins = self.train_data.bins
+                if self.n_pad != self.num_data:
+                    bins = np.pad(bins, ((0, 0),
+                                         (0, self.n_pad - self.num_data)))
+                self.bins_dev = self.grower.shard_bins(bins)
+            self._layout_active = False
+            self._shard_layout = None
             self._mh_fused = False
             self._fused_sharded = False
             # the general path has no device stopped flag: deferred
@@ -1492,10 +1621,19 @@ class GBDT:
             self._gstate_override = None
             self._trees_since_reorder = 0
             return
-        if self._row_order is None:
+        if self._row_order is None and not self._layout_active:
             return
         inv = self._inverse_row_order()
-        self.scores = jnp.take(self.scores, inv, axis=1)
+        if inv is not None:
+            self.scores = jnp.take(self.scores, inv, axis=1)
+        if self._layout_active:
+            # query-granular layout -> file order + trailing pad (the
+            # general path's convention); _ensure_layout re-places when
+            # the fused path resumes
+            s = jnp.take(self.scores, self._layout_pos_dev(), axis=1)
+            self.scores = jnp.pad(
+                s, ((0, 0), (0, self.n_pad - self.num_data)))
+            self._layout_active = False
         bins = self.train_data.bins
         if self.n_pad != self.num_data:
             bins = np.pad(bins, ((0, 0), (0, self.n_pad - self.num_data)))
@@ -1508,10 +1646,12 @@ class GBDT:
         self._gstate_override = None
         self._trees_since_reorder = 0
 
-    def _mh_local_file_scores(self) -> np.ndarray:
+    def _mh_local_base_scores(self) -> np.ndarray:
         """Multi-host fused: this process's [K, n_pad] block of the
-        global row-sharded scores, restored to FILE order (undoing any
-        shard-local ordered-partition permutation on the host)."""
+        global row-sharded scores with any shard-local ordered-partition
+        permutation undone (base layout space — file order + trailing
+        pad for the default layout, query-granular blocks under the
+        rank shard layout)."""
         s = np.asarray(self.grower.local_rows(self.scores))
         if self._row_order is not None:
             base = jax.process_index() * self.n_pad
@@ -1520,6 +1660,14 @@ class GBDT:
             out = np.empty_like(s)
             out[:, ordl] = s
             s = out
+        return s
+
+    def _mh_local_file_scores(self) -> np.ndarray:
+        """Multi-host fused: this process's [K, n_pad] block restored to
+        FILE order (+ trailing pad)."""
+        s = self._mh_local_base_scores()
+        if self._layout_active:
+            s = self._unplace_host(s)
         return s
 
     def _training_score(self):
@@ -1532,6 +1680,8 @@ class GBDT:
             # ordered-partition mode keeps per-row state sorted by tree
             # leaves; metrics (and any external reader) see file order
             s = jnp.take(s, inv, axis=1)
+        if self._layout_active:
+            s = jnp.take(s, self._layout_pos_dev(), axis=1)
         s = s[:, :self.num_data]
         return s[0] if self.num_class == 1 else s
 
@@ -1984,6 +2134,10 @@ class GBDT:
             inv = self._inverse_row_order()
             if inv is not None:
                 scores = scores[:, np.asarray(inv)]
+            if self._layout_active:
+                # checkpoints always store FILE order (+ trailing pad);
+                # load_checkpoint re-places into the layout
+                scores = self._unplace_host(scores)
         arrays = {
             "iter": np.int64(self.iter),
             "num_used_model": np.int64(self.num_used_model),
@@ -2038,11 +2192,25 @@ class GBDT:
         # checkpointed per-row state is in FILE order; when the snapshot
         # carries an ordered-partition row order, rebuild the exact
         # permuted state (bins/scores/objective state) so training
-        # resumes bit-for-bit on the same accumulation order
+        # resumes bit-for-bit on the same accumulation order.  "Base"
+        # space below = file order + trailing pad, or the query-granular
+        # layout blocks when the rank shard layout is configured (the
+        # row order permutes base positions in both cases).
+        lay = self._shard_layout
         bins = self.train_data.bins if self.train_data is not None else None
-        if bins is not None and self.n_pad != self.num_data:
-            bins = np.pad(bins, ((0, 0), (0, self.n_pad - self.num_data)))
-        ordl = None     # this process's local file-row permutation
+        if bins is not None:
+            if lay is not None:
+                bins = lay.place(bins)
+            elif self.n_pad != self.num_data:
+                bins = np.pad(bins,
+                              ((0, 0), (0, self.n_pad - self.num_data)))
+        z_file = np.asarray(z["scores"])
+        if lay is not None:
+            self._layout_active = True
+            z_base = lay.place(z_file[:, :self.num_data])
+        else:
+            z_base = z_file
+        ordl = None     # this process's local base-space permutation
         if "row_order" in z:
             order = np.asarray(z["row_order"])
             self._trees_since_reorder = int(z["trees_since_reorder"])
@@ -2054,37 +2222,25 @@ class GBDT:
                 self._row_order = self.grower.shard_rows(
                     order.astype(np.int32), self.n_pad)
                 self.bins_dev = self.grower.shard_bins(bins[:, ordl])
-                gs_local = self.objective.make_permute_fn()(
-                    self.objective.grad_state(), jnp.asarray(ordl)) \
-                    if getattr(self.objective, "row_permutable", False) \
-                    else None
-                self._gstate_override = (
-                    None if gs_local is None else jax.tree_util.tree_map(
-                        lambda a: self.grower.shard_rows(np.asarray(a),
-                                                         self.n_pad),
-                        gs_local))
-                z_scores = np.asarray(z["scores"])[:, ordl]
+                self._gstate_override = self._restored_gstate(ordl)
+                z_scores = z_base[:, ordl]
             else:
                 ordl = order
                 self._row_order = jnp.asarray(order, dtype=jnp.int32)
                 self.bins_dev = jnp.asarray(bins[:, order])
-                # rebuild the permuted grad_state through the objective's
-                # own permute fn (lambdarank remaps doc_idx; elementwise
-                # objectives take along the last axis)
-                self._gstate_override = self.objective.make_permute_fn()(
-                    self.objective.grad_state(), self._row_order) \
-                    if getattr(self.objective, "row_permutable", False) \
-                    else None
-                z_scores = np.asarray(z["scores"])[:, order]
+                self._gstate_override = self._restored_gstate(ordl)
+                z_scores = z_base[:, order]
             bag_restored = True
         else:
-            if self._row_order is not None and bins is not None:
+            if bins is not None and (self._row_order is not None
+                                     or lay is not None):
                 self.bins_dev = (self.grower.shard_bins(bins)
-                                 if self._mh_fused else jnp.asarray(bins))
+                                 if self._mh_fused or lay is not None
+                                 else jnp.asarray(bins))
             self._row_order = None
             self._trees_since_reorder = 0
             self._gstate_override = None
-            z_scores = np.asarray(z["scores"])
+            z_scores = z_base
             bag_restored = False
         self._inv_order = None
         if self._mh_fused:
@@ -2102,7 +2258,10 @@ class GBDT:
         if bag_restored:
             # the fused-path device bag mask must follow the restored row
             # order (host bag_masks stay in file order like everything host)
-            bag_ordered = self.bag_masks[0][ordl]
+            bag_base = self.bag_masks[0]
+            if lay is not None:
+                bag_base = lay.place(bag_base[:self.num_data], fill=False)
+            bag_ordered = bag_base[ordl]
             self._bag_dev_packed[0] = (
                 self.grower.shard_rows(bag_ordered, self.n_pad)
                 if self._mh_fused else jnp.asarray(bag_ordered))
@@ -2130,6 +2289,30 @@ class GBDT:
         self.num_used_model = min(int(z["num_used_model"]),
                                   len(self._models) // self.num_class)
         self._restore_extra_checkpoint(z)
+
+    def _restored_gstate(self, ordl):
+        """Gradient-state override matching a restored row order: the
+        objective's permute fn over base state (elementwise), or the
+        host-side per-shard permute of the query-sharded state (the
+        re-sorts were shard-local, so the permutation applies block by
+        block before the device put)."""
+        if self._layout_active:
+            host, specs = self._build_sharded_gstate_host()
+            host = self.objective.permute_sharded_state_host(
+                host, self._shard_layout, ordl)
+            self._gstate_specs = specs
+            return tuple(self.grower.put_spec(a, sp)
+                         for a, sp in zip(host, specs))
+        if not getattr(self.objective, "row_permutable", False):
+            return None
+        gs = self.objective.make_permute_fn()(
+            self.objective.grad_state(),
+            jnp.asarray(np.asarray(ordl), dtype=jnp.int32))
+        if self._mh_fused:
+            gs = jax.tree_util.tree_map(
+                lambda a: self.grower.shard_rows(np.asarray(a),
+                                                 self.n_pad), gs)
+        return gs
 
     def _rng_streams(self):
         out = [("bag_rng", self.bag_rng)]

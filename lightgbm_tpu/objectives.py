@@ -45,11 +45,13 @@ class Objective:
     # whose state carries row INDICES (lambdarank's doc_idx) override
     # make_permute_fn to remap them instead.
     row_permutable = False
-    # True when every grad_state leaf is per-row on its LAST axis so the
-    # single-host data-parallel fused step may shard it along the data
-    # axis (models/gbdt.py _make_fused_step_sharded).  Lambdarank's
-    # query-block state is row-structured, not row-sharded, so it must
-    # stay False there and take the general data-parallel path.
+    # True when the data-parallel fused step can shard grad_state along
+    # the data axis (models/gbdt.py _make_fused_step_sharded).  Two ways
+    # to qualify: every leaf is per-row on its LAST axis (the default
+    # sharding; regression/binary/multiclass), or the objective provides
+    # its own query-granular layout + sharded state via shard_layout /
+    # build_sharded_state (lambdarank's device path: the [Q, Lmax]
+    # query-block state shards along Q with shard-local row indices).
     row_shardable = False
     name = "none"
     num_class = 1
@@ -107,6 +109,24 @@ class Objective:
             return jax.tree_util.tree_map(
                 lambda a: jnp.take(a, rel, axis=-1), gstate)
         return permute
+
+    # -- query-granular sharding surface (tree_learner=data) -----------
+    # Objectives whose grad_state is NOT per-row on its last axis (the
+    # lambdarank query blocks) implement these two hooks to still run
+    # the fused shard_map step: shard_layout returns the row placement
+    # (rows of one query stay on one shard), build_sharded_state the
+    # matching shard-major gradient state + PartitionSpecs.
+    def shard_layout(self, local_shards: int, row_unit: int, mh: bool):
+        """RowShardLayout (parallel/mesh.py) for the data-parallel fused
+        step, or None when the default contiguous row blocks work (every
+        elementwise objective)."""
+        return None
+
+    def build_sharded_state(self, layout, sync=None):
+        """-> (host_leaves, specs): numpy grad_state blocks laid out
+        shard-major for `layout` plus one PartitionSpec per leaf.  Only
+        called when shard_layout returned a layout."""
+        raise NotImplementedError
 
     def convert_output(self, score: np.ndarray) -> np.ndarray:
         """Final transform for human-facing predictions."""
@@ -378,6 +398,11 @@ class LambdarankNDCG(Objective):
         # rides along and doc_idx remaps through the inverse permutation
         # (make_permute_fn)
         self.row_permutable = self.impl == "device"
+        # ... and the data-parallel fused step may shard it: rows shard
+        # query-granularly (shard_layout below), each shard's query
+        # blocks carry SHARD-LOCAL doc indices, and the same grad_fn /
+        # permute_fn run unchanged per shard inside shard_map
+        self.row_shardable = self.impl == "device"
 
     # -- device path ---------------------------------------------------
     def _build_device_state(self) -> None:
@@ -467,6 +492,112 @@ class LambdarankNDCG(Objective):
             return (inv_rel[di], lab, gain, inv, wts,
                     jnp.take(row_slot, rel), disc)
         return permute
+
+    # -- query-granular sharding (tree_learner=data fused step) --------
+    def shard_layout(self, local_shards: int, row_unit: int, mh: bool):
+        """Rows shard on query boundaries: shard s's contiguous device
+        block holds whole queries [bounds[s], bounds[s+1]) padded to a
+        common capacity, the invariant that lets each shard compute its
+        queries' pairwise lambdas from its OWN score block (reference
+        rank training under data parallelism is likewise query-local —
+        only histograms cross machines,
+        data_parallel_tree_learner.cpp:124-187)."""
+        if self.impl != "device":
+            return None
+        from .parallel.mesh import query_shard_layout
+        sync = None
+        if mh:
+            from .parallel.dist import sync_max_ints
+            sync = sync_max_ints
+        return query_shard_layout(self.qb, local_shards, row_unit, sync)
+
+    def build_sharded_state(self, layout, sync=None):
+        """Shard-major [S*nb, QB, Lmax] query-block state for the fused
+        shard_map step: the serial _build_device_state layout rebuilt
+        per shard with SHARD-LOCAL doc indices (row positions inside the
+        shard's own score block) and a per-shard row_slot / dead slot.
+        Every shard gets identically-shaped blocks (SPMD); multi-host
+        passes `sync` so lmax / queries-per-shard agree globally.
+        make_grad_fn's function consumes this state unchanged inside
+        shard_map — per-query lambdas are independent of the blocking,
+        so gradients are bit-identical to the serial device path."""
+        from jax.sharding import PartitionSpec as P
+
+        from .parallel.mesh import DATA_AXIS
+
+        qb = np.asarray(self.qb, dtype=np.int64)
+        qlen = (qb[1:] - qb[:-1]).astype(np.int64)
+        nq = len(qb) - 1
+        lmax = max(1, int(qlen.max()) if nq else 1)
+        bounds = layout.bounds
+        nq_cap = max(1, int((bounds[1:] - bounds[:-1]).max()))
+        if sync is not None:
+            lmax, nq_cap = (int(v) for v in sync([lmax, nq_cap]))
+        # same pair-tensor budget as the serial builder: ~16M pair
+        # elements per scanned block
+        q_block = int(min(max(1, (1 << 24) // (lmax * lmax)),
+                          max(nq_cap, 1)))
+        nb = max(1, -(-nq_cap // q_block))
+        nq_pad = nb * q_block
+        S = layout.local_shards
+        label = np.asarray(self.metadata.label)
+
+        doc_idx = np.zeros((S, nq_pad, lmax), dtype=np.int32)
+        lab = np.full((S, nq_pad, lmax), -1, dtype=np.int32)
+        gain = np.zeros((S, nq_pad, lmax), dtype=np.float32)
+        wts = np.ones((S, nq_pad, lmax), dtype=np.float32)
+        inv = np.zeros((S, nq_pad), dtype=np.float32)
+        dead = nq_pad * lmax          # per-shard flat output size
+        row_slot = np.full((S, layout.cap), dead, dtype=np.int32)
+        ar = np.arange(lmax, dtype=np.int64)
+        for s in range(S):
+            base = int(qb[bounds[s]])
+            for qi, q in enumerate(range(int(bounds[s]),
+                                         int(bounds[s + 1]))):
+                a, ln = int(qb[q]), int(qlen[q])
+                doc_idx[s, qi] = (a - base) + np.minimum(ar,
+                                                         max(ln - 1, 0))
+                lab[s, qi, :ln] = label[a:a + ln].astype(np.int32)
+                gain[s, qi, :ln] = self.label_gain[lab[s, qi, :ln]]
+                if self.weights is not None:
+                    wts[s, qi, :ln] = self.weights[a:a + ln]
+                inv[s, qi] = self.inverse_max_dcgs[q]
+                row_slot[s, a - base:a - base + ln] = (
+                    qi * lmax + np.arange(ln, dtype=np.int64))
+
+        shp = (S * nb, q_block)
+        host = (doc_idx.reshape(shp + (lmax,)),
+                lab.reshape(shp + (lmax,)),
+                gain.reshape(shp + (lmax,)),
+                inv.reshape(shp),
+                wts.reshape(shp + (lmax,)),
+                row_slot.reshape(-1),
+                self.discount.copy())
+        specs = (P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                 P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                 P(DATA_AXIS, None, None), P(DATA_AXIS), P())
+        return host, specs
+
+    @staticmethod
+    def permute_sharded_state_host(host, layout, order_local):
+        """Apply a checkpointed ordered-partition row order to the HOST
+        sharded state (load_checkpoint restore): re-sorts are shard-
+        local, so each shard's doc_idx remaps through the inverse of its
+        own block of the order and row_slot rides the permutation —
+        exactly make_permute_fn per shard, done in numpy before the
+        device put."""
+        di, lab, gain, inv, wts, row_slot, disc = host
+        S, cap = layout.local_shards, layout.cap
+        nb = di.shape[0] // S
+        di = di.copy()
+        row_slot = row_slot.reshape(S, cap).copy()
+        ordl = np.asarray(order_local).reshape(S, cap)
+        for s in range(S):
+            rel = ordl[s] - s * cap
+            inv_rel = np.argsort(rel).astype(np.int32)
+            di[s * nb:(s + 1) * nb] = inv_rel[di[s * nb:(s + 1) * nb]]
+            row_slot[s] = row_slot[s][rel]
+        return (di, lab, gain, inv, wts, row_slot.reshape(-1), disc)
 
     def make_grad_fn(self):
         sigmoid = float(self.sigmoid)

@@ -88,6 +88,19 @@ def init_distributed(config) -> Tuple[int, int]:
     coordinator = "%s:%d" % machines[0]
     import jax
 
+    plat = jax.config.jax_platforms
+    if plat is None or "cpu" in plat:
+        # CPU-only clusters (CI, local smoke runs): cross-process
+        # collectives need the gloo implementation — without it the
+        # compiler rejects multiprocess computations outright.  None =
+        # automatic backend selection, which may well land on CPU; the
+        # setting only configures the CPU client, so it is harmless
+        # when an accelerator wins.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=config.num_machines,
                                process_id=rank)
@@ -117,6 +130,15 @@ def process_concat(array: np.ndarray) -> np.ndarray:
     stacked = process_allgather(pad)          # [P, mx, ...]
     return np.concatenate([stacked[p, :int(lens[p])]
                            for p in range(stacked.shape[0])], axis=0)
+
+
+def sync_max_ints(values) -> np.ndarray:
+    """Element-wise max of a small int vector across processes — shard
+    metadata agreement (the query-sharded rank layout needs every process
+    to build identically-shaped gradient-state blocks: per-shard row
+    capacity, longest query, max queries per shard)."""
+    vals = np.asarray(values, dtype=np.int64).reshape(-1)
+    return process_allgather(vals).max(axis=0)
 
 
 def sync_config_by_min(config) -> None:

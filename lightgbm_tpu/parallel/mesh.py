@@ -20,6 +20,7 @@ over ICI within a slice and DCN across slices.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -35,6 +36,20 @@ DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
 
+def shard_map(fn, *, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level API (with its
+    check_vma knob) when present, else the older experimental API (whose
+    equivalent knob is check_rep).  Every shard_map in this package goes
+    through here so version skew cannot silently disable one path."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(num_shards: int = 0, axis: str = DATA_AXIS) -> Mesh:
     devs = jax.devices()
     if num_shards <= 0:
@@ -47,6 +62,82 @@ def make_mesh(num_shards: int = 0, axis: str = DATA_AXIS) -> Mesh:
 
 def padded_size(n: int, num_shards: int) -> int:
     return ((n + num_shards - 1) // num_shards) * num_shards
+
+
+def query_shard_bounds(query_boundaries, num_shards: int) -> np.ndarray:
+    """Contiguous query -> shard partition for query-granular row
+    sharding (lambdarank under tree_learner=data): shard s owns queries
+    [bounds[s], bounds[s+1]), with each boundary placed on the query
+    boundary nearest the ideal equal-row cut, so no query ever straddles
+    a shard block — the invariant the query-sharded fused gradient state
+    relies on (objectives.LambdarankNDCG.build_sharded_state).  Returns
+    bounds [num_shards + 1] (query indices, non-decreasing; shards may
+    be empty when there are fewer queries than shards)."""
+    qb = np.asarray(query_boundaries, dtype=np.int64)
+    nq = len(qb) - 1
+    n = int(qb[-1])
+    bounds = np.zeros(num_shards + 1, dtype=np.int64)
+    bounds[num_shards] = nq
+    for s in range(1, num_shards):
+        t = n * s / num_shards
+        i = int(np.searchsorted(qb, t))
+        if i > nq or (i > 0 and qb[i] - t > t - qb[i - 1]):
+            i -= 1
+        bounds[s] = min(max(i, int(bounds[s - 1])), nq)
+    return bounds
+
+
+@dataclasses.dataclass
+class RowShardLayout:
+    """Query-granular device row layout for the data-parallel fused step
+    with a query-structured objective (lambdarank): shard s's contiguous
+    block of the row axis holds exactly the rows of queries
+    [bounds[s], bounds[s+1]), padded to the common per-shard capacity
+    `cap`, so no query ever straddles a shard and every shard's gradient
+    state is self-contained.  `pos` maps LOCAL file rows to their local
+    padded positions; gap rows (between a shard's last real row and its
+    capacity) are permanently out-of-bag, exactly like trailing pad rows
+    in the default layout."""
+    cap: int                  # rows per shard block (row_unit-aligned)
+    local_shards: int         # shards owned by THIS process
+    n_pad: int                # local padded rows == cap * local_shards
+    bounds: np.ndarray        # [local_shards + 1] query cuts (local)
+    pos: np.ndarray           # [n_local] i32 file row -> padded position
+
+    def place(self, arr: np.ndarray, fill=0) -> np.ndarray:
+        """File-order rows (last axis) -> padded layout order."""
+        out = np.full(arr.shape[:-1] + (self.n_pad,), fill,
+                      dtype=arr.dtype)
+        out[..., self.pos] = arr
+        return out
+
+    def unplace(self, arr: np.ndarray) -> np.ndarray:
+        """Padded layout order (last axis) -> file-order rows."""
+        return np.asarray(arr)[..., self.pos]
+
+
+def query_shard_layout(query_boundaries, local_shards: int,
+                       row_unit: int = 1, sync=None) -> RowShardLayout:
+    """Build the RowShardLayout for this process's queries over its
+    `local_shards` mesh devices.  `row_unit` aligns the per-shard
+    capacity (the Pallas row block).  Multi-host passes `sync` (dist.
+    sync_max_ints) so every process agrees on the global capacity —
+    equal per-device blocks are required by the global array assembly."""
+    qb = np.asarray(query_boundaries, dtype=np.int64)
+    bounds = query_shard_bounds(qb, local_shards)
+    rows = qb[bounds[1:]] - qb[bounds[:-1]]
+    cap = max(int(rows.max()) if len(rows) else 1, 1)
+    cap = -(-cap // row_unit) * row_unit
+    if sync is not None:
+        cap = int(sync([cap])[0])
+    n = int(qb[-1])
+    pos = np.empty(n, dtype=np.int32)
+    for s in range(local_shards):
+        a, b = int(qb[bounds[s]]), int(qb[bounds[s + 1]])
+        pos[a:b] = s * cap + np.arange(b - a, dtype=np.int32)
+    return RowShardLayout(cap=cap, local_shards=local_shards,
+                          n_pad=cap * local_shards, bounds=bounds,
+                          pos=pos)
 
 
 def _put_sharded(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
@@ -89,9 +180,8 @@ def _sharded_grow_fn(mesh: Mesh, grow_kw: dict, in_specs, leaf_id_spec: P):
     shared scaffolding of the row- and feature-sharded growers."""
     fn = functools.partial(grow_tree, **grow_kw)
     tree_specs = TreeArrays(*([P()] * len(TreeArrays._fields)))
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=(tree_specs, leaf_id_spec),
-                                 check_vma=False))
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=(tree_specs, leaf_id_spec)))
 
 
 class ShardedGrower:
@@ -141,6 +231,20 @@ class ShardedGrower:
             arr, n_pad, fill, self.mesh,
             P(*([None] * (arr.ndim - 1) + [DATA_AXIS])))
 
+    def put_spec(self, arr, spec: P) -> jax.Array:
+        """Place a host array with an arbitrary PartitionSpec (multi-host:
+        arr is this process's block of every sharded dim).  Used for
+        gradient state whose leaves shard on a non-last axis (the
+        query-sharded lambdarank blocks)."""
+        return _put_sharded(np.asarray(arr), self.mesh, spec)
+
+    def local_shard_count(self) -> int:
+        """Mesh shards owned by THIS process (== num_shards single-host)."""
+        if jax.process_count() == 1:
+            return self.num_shards
+        return sum(int(d.process_index == jax.process_index())
+                   for d in self.mesh.devices.flat)
+
     def grow(self, bins_dev, grad, hess, bag_mask, feature_mask):
         return self._grow(bins_dev, grad, hess, bag_mask, feature_mask)
 
@@ -156,7 +260,7 @@ class ShardedGrower:
                 base = jax.lax.axis_index(DATA_AXIS) * o.shape[-1]
                 return jnp.take(a, o - base, axis=-1)
             spec = P(*([None] * (arr.ndim - 1) + [DATA_AXIS]))
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map(
                 body, mesh=self.mesh,
                 in_specs=(spec, P(DATA_AXIS)), out_specs=spec))
             self._permute[arr.ndim] = fn

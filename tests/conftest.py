@@ -12,6 +12,13 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+# jaxlib 0.4.36's persistent compilation cache corrupts the heap on this
+# CPU backend (layout-sensitive "corrupted size vs. prev_size" aborts /
+# segfaults that killed whole pytest runs at ~test 14 — root-caused by
+# bisection: disabling ONLY the cache makes every run complete).  Tests
+# don't need cold-compile amortization; production keeps the cache.
+# setdefault: an operator who explicitly configured the cache wins.
+os.environ.setdefault("LGBM_TPU_NO_COMPILE_CACHE", "1")
 
 import jax  # noqa: E402
 

@@ -24,6 +24,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+try:
+    # cross-process collectives on the CPU backend need the gloo
+    # implementation (without it the compiler rejects multiprocess
+    # computations outright on CPU-only boxes)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=nproc, process_id=rank)
 

@@ -18,6 +18,10 @@ import sys
 rank, nproc, port, data, out, ordered = (
     int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
     sys.argv[5], sys.argv[6])
+# switch=1: leave the fused path mid-training via custom gradients
+# (the ADVICE r5 bins_dev regression — see below) instead of the
+# checkpoint/resume leg
+switch = int(sys.argv[7]) if len(sys.argv) > 7 else 0
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
@@ -26,6 +30,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:
+    # cross-process collectives on the CPU backend need the gloo
+    # implementation (without it the compiler rejects multiprocess
+    # computations outright on CPU-only boxes)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(coordinator_address="localhost:" + port,
                            num_processes=nproc, process_id=rank)
 
@@ -45,6 +56,13 @@ ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
 obj = create_objective(cfg)
 obj.init(ds.metadata, ds.num_data)
 booster = create_boosting(cfg, ds, obj)
+if switch:
+    # custom gradients for the mid-training fused-path exit below: a
+    # pure function of the GLOBAL row id, so every cluster mode feeds
+    # identical values (computed up front, before training starts)
+    import numpy as np
+    grad_sw = np.sin(0.37 * ds.local_rows).astype(np.float32)
+    hess_sw = (0.6 + 0.4 * np.cos(0.11 * ds.local_rows)).astype(np.float32)
 assert booster._mh_fused and booster._can_fuse(), "must take mh fused path"
 if ordered != "off":
     assert booster.hist_ranged, "ordered mode must be active"
@@ -53,19 +71,32 @@ for _ in range(3):
 if ordered != "off":
     assert booster._row_order is not None, "mh re-sort must have run"
 
-# exact-state checkpoint/resume under the multi-host fused path: each
-# rank snapshots ITS file-order block + its slice of the global row
-# order; a fresh booster restored from it must continue bit-for-bit
-ckpt = out + ".rank%d.ckpt" % rank
-booster.save_checkpoint(ckpt)
-resumed = create_boosting(cfg, ds, obj)
-resumed.load_checkpoint(ckpt)
-for b in (booster, resumed):
-    for _ in range(3):
-        b.train_one_iter(None, None, False)
-ma = "".join(t.to_string() for t in booster.models)
-mb = "".join(t.to_string() for t in resumed.models)
-assert ma == mb, "mh checkpoint resume diverged from uninterrupted run"
+if switch:
+    # regression (ADVICE r5 medium, gbdt._restore_row_order): leaving
+    # the multi-host fused path via CUSTOM gradients while an ordered-
+    # partition row order is active must rebuild the global bins_dev
+    # from FILE order — before the fix the general path kept growing
+    # later trees on leaf-permuted bins against file-order gradients,
+    # silently corrupting every subsequent tree.
+    booster.train_one_iter(grad_sw, hess_sw, False)
+    assert not booster._mh_fused, "custom grads must exit the fused path"
+    for _ in range(2):
+        booster.train_one_iter(None, None, False)
+else:
+    # exact-state checkpoint/resume under the multi-host fused path:
+    # each rank snapshots ITS file-order block + its slice of the
+    # global row order; a fresh booster restored from it must continue
+    # bit-for-bit
+    ckpt = out + ".rank%d.ckpt" % rank
+    booster.save_checkpoint(ckpt)
+    resumed = create_boosting(cfg, ds, obj)
+    resumed.load_checkpoint(ckpt)
+    for b in (booster, resumed):
+        for _ in range(3):
+            b.train_one_iter(None, None, False)
+    ma = "".join(t.to_string() for t in booster.models)
+    mb = "".join(t.to_string() for t in resumed.models)
+    assert ma == mb, "mh checkpoint resume diverged from uninterrupted run"
 
 booster.save_model_to_file(-1, True, out)
 print("worker %d done (%s): %d trees" % (rank, ordered,

@@ -1,0 +1,90 @@
+"""Worker for the multi-host RANK fused test
+(test_parallel.py::test_multihost_rank_fused_matches_general).
+
+Usage: python mh_rank_worker.py <rank> <nproc> <port> <data> <out> <mode>
+
+mode=fused trains lambdarank through the query-sharded fused shard_map
+step over the cross-process mesh (each process's lottery shard holds
+whole queries; its gradient state is per-shard [Q, Lmax] blocks with
+shard-local doc indices), with a transfer audit proving steady
+iterations upload NOTHING O(rows) — per-iteration host traffic is the
+O(packed tree) pull only.  mode=general forces the per-tree host-loop
+path the fused step replaced (same device gradient impl, so models must
+match byte-for-byte under hist_dtype=float64).
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out, mode = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    # cross-process collectives on the CPU backend need the gloo
+    # implementation (without it the compiler rejects multiprocess
+    # computations outright on CPU-only boxes)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+cfg = Config.from_params({
+    "objective": "lambdarank", "tree_learner": "data", "num_leaves": "8",
+    "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+    "hist_dtype": "float64", "metric": "", "is_save_binary_file": "false"})
+ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+if mode == "general":
+    # the pre-fusion path: per-tree host gradients + shard_rows uploads
+    # (same device gradient impl — the bit-parity oracle for the fused
+    # query-sharded step)
+    obj.row_shardable = False
+booster = create_boosting(cfg, ds, obj)
+if mode == "fused":
+    assert booster._mh_fused and booster._can_fuse(), \
+        "multi-host rank must take the fused query-sharded path"
+    assert booster._layout_active and booster._shard_layout is not None
+else:
+    assert not booster._mh_fused and not booster._can_fuse()
+booster.train_one_iter(None, None, False)
+if mode == "fused":
+    # transfer audit: the first iteration assembled the global scores /
+    # bins / query-sharded gradient state; steady iterations must upload
+    # nothing O(rows) — the general path pays two O(N_local) shard_rows
+    # round trips (grad + hess) per tree
+    uploads = []
+    _orig_sr = booster.grower.shard_rows
+    _orig_ps = booster.grower.put_spec
+    booster.grower.shard_rows = lambda *a, **k: (
+        uploads.append(("shard_rows", a[0].shape)), _orig_sr(*a, **k))[1]
+    booster.grower.put_spec = lambda *a, **k: (
+        uploads.append(("put_spec", a[0].shape)), _orig_ps(*a, **k))[1]
+    for _ in range(2):
+        booster.train_one_iter(None, None, False)
+    booster.grower.shard_rows = _orig_sr
+    booster.grower.put_spec = _orig_ps
+    assert not uploads, \
+        "steady fused rank iterations re-uploaded per-row state: %r" \
+        % uploads
+else:
+    for _ in range(2):
+        booster.train_one_iter(None, None, False)
+booster.save_model_to_file(-1, True, out)
+print("worker %d done (%s): %d trees" % (rank, mode,
+                                         len(booster.models)))

@@ -432,3 +432,37 @@ def test_matrix_bin_sample_rng_matches_file_path():
         for mf, mm in zip(file_ds.bin_mappers, mat_ds.inner.bin_mappers):
             np.testing.assert_array_equal(mf.bin_upper_bound,
                                           mm.bin_upper_bound)
+
+
+def test_sparse_predict_empty_rows_shape_matches_dense():
+    """0-row sparse input must produce mode-SHAPED empty output exactly
+    like the dense path — (0,) binary raw, (0, K) multiclass, (0, T)
+    pred_leaf — not a bare np.zeros(0) regardless of mode (ADVICE r5)."""
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    n, f, k = 600, 8, 3
+    x = rng.randn(n, f)
+    yb = (x[:, 0] > 0).astype(np.float64)
+    ym = np.digitize(x[:, 0], [-0.5, 0.5]).astype(np.float64)
+
+    bb = lgb.train({"objective": "binary", "num_leaves": 8, "metric": ""},
+                   lgb.Dataset(x, label=yb), num_boost_round=3,
+                   verbose_eval=False)
+    bm = lgb.train({"objective": "multiclass", "num_class": k,
+                    "num_leaves": 8, "metric": ""},
+                   lgb.Dataset(x, label=ym), num_boost_round=2,
+                   verbose_eval=False)
+
+    for kind in (sp.csr_matrix, sp.csc_matrix):
+        empty = kind((0, f))
+        for bst, kwargs in ((bb, {}), (bb, {"raw_score": True}),
+                            (bm, {}), (bm, {"raw_score": True}),
+                            (bb, {"pred_leaf": True}),
+                            (bm, {"pred_leaf": True})):
+            got = bst.predict(empty, **kwargs)
+            want = bst.predict(np.zeros((0, f)), **kwargs)
+            assert got.shape == want.shape, (kind, kwargs, got.shape,
+                                             want.shape)
+            assert got.dtype == want.dtype

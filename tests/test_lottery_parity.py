@@ -323,3 +323,77 @@ def test_two_round_equals_one_round_below_fill(data):
         np.testing.assert_array_equal(a.local_rows, b.local_rows)
         np.testing.assert_array_equal(a.bins, b.bins)
         np.testing.assert_array_equal(a.metadata.label, b.metadata.label)
+
+
+def test_rank_cache_seed_or_granularity_change_falls_back(tmp_path, data):
+    """The rank-tagged cache's `.rows.npz` sidecar records the lottery's
+    data_random_seed and granularity (query vs row); a re-run under a
+    DIFFERENT seed — or with a `.query` sidecar appearing — must ignore
+    the cache and re-lottery from text.  Silently reusing the stale
+    partition would desync the cluster: ranks whose caches were deleted
+    would draw the NEW stream, duplicating or dropping rows."""
+    import shutil
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import load_dataset
+
+    f = str(tmp_path / "t.tsv")
+    shutil.copy(data["row"], f)
+
+    def load(rank, seed, save=False):
+        cfg = Config.from_params({
+            "objective": "binary", "data_random_seed": str(seed),
+            "bin_construct_sample_cnt": "200000",
+            "is_save_binary_file": "true" if save else "false",
+            "enable_load_from_binary_file": "true", "label_column": "0"})
+        return load_dataset(f, cfg, rank=rank, num_shards=2)
+
+    first = [load(r, seed=1, save=True) for r in range(2)]
+    for r in range(2):
+        side = "%s.r%dof2.bin.rows.npz" % (f, r)
+        assert os.path.isfile(side)
+        with np.load(side) as z:
+            assert int(z["seed"]) == 1
+            assert int(z["query_lottery"]) == 0
+    # same seed: the caches load (recorded partition, no text touch)
+    np.testing.assert_array_equal(load(0, seed=1).local_rows,
+                                  first[0].local_rows)
+    # seed change: the caches must be IGNORED — per-rank rows must
+    # equal a fresh text lottery under the new seed, and together they
+    # must still partition the file
+    fresh = [load(r, seed=9) for r in range(2)]
+    for r in range(2):
+        cfg2 = Config.from_params({
+            "objective": "binary", "data_random_seed": "9",
+            "bin_construct_sample_cnt": "200000",
+            "is_save_binary_file": "false",
+            "enable_load_from_binary_file": "false",
+            "label_column": "0"})
+        want = load_dataset(f, cfg2, rank=r, num_shards=2)
+        np.testing.assert_array_equal(fresh[r].local_rows,
+                                      want.local_rows)
+    merged = np.sort(np.concatenate([d.local_rows for d in fresh]))
+    np.testing.assert_array_equal(merged, np.arange(data["n"]))
+    assert not np.array_equal(fresh[0].local_rows, first[0].local_rows)
+
+    # granularity flip: a .query sidecar appearing after a row-granular
+    # cache was written must also force the text fallback
+    sizes = [20, 17, 30, 25, 30, 35]
+    assert sum(sizes) == data["n"]
+    (tmp_path / "t.tsv.query").write_text(
+        "\n".join(map(str, sizes)) + "\n")
+    qd = [load(r, seed=1) for r in range(2)]
+    # whole queries per rank now — impossible if the stale row-granular
+    # cache had been reused
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    for d in qd:
+        heads = set(qb[:-1].tolist())
+        pos = 0
+        rows = d.local_rows
+        while pos < len(rows):
+            g0 = int(rows[pos])
+            assert g0 in heads
+            qi = int(np.searchsorted(qb, g0))
+            ln = sizes[qi]
+            np.testing.assert_array_equal(rows[pos:pos + ln],
+                                          np.arange(g0, g0 + ln))
+            pos += ln

@@ -589,6 +589,66 @@ def test_multihost_ordered_fused_matches_unordered(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_ordered_custom_grad_switch_rebuilds_bins(tmp_path):
+    """Regression (ADVICE r5 medium): switching to train_one_iter(grad,
+    hess) mid-training on the multi-host fused + hist_ordered path must
+    rebuild bins_dev from FILE order before the general path grows later
+    trees.  Before the fix the ordered cluster kept leaf-permuted bins,
+    so its post-switch trees silently diverged from the unordered
+    cluster fed the IDENTICAL gradient sequence."""
+    import os
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(8)
+    n, ncol = 4096, 6
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(int)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "mh_ordered_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def run_cluster(ordered):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+        s.close()
+        outs = [str(tmp_path / ("model_sw_%s_%d.txt" % (ordered, r)))
+                for r in range(2)]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", port, str(data),
+             outs[r], ordered, "1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(2)]
+        logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+        for r, p in enumerate(procs):
+            assert p.returncode == 0, "worker %d (%s) failed:\n%s" % (
+                r, ordered, logs[r])
+        m0, m1 = open(outs[0]).read(), open(outs[1]).read()
+        assert m0 == m1, "ranks saved different models (%s)" % ordered
+        return m0
+
+    m_off = run_cluster("off")
+    m_on = run_cluster("auto")
+    off_trees = m_off.split("Tree=")[1:]
+    on_trees = m_on.split("Tree=")[1:]
+    assert len(off_trees) == len(on_trees) == 6
+    for i, (a, b) in enumerate(zip(off_trees, on_trees)):
+        da = {ln.split("=")[0]: ln.split("=", 1)[1]
+              for ln in a.splitlines()[1:] if "=" in ln}
+        db = {ln.split("=")[0]: ln.split("=", 1)[1]
+              for ln in b.splitlines()[1:] if "=" in ln}
+        for key in ("num_leaves", "split_feature", "threshold"):
+            assert da[key] == db[key], "tree %d %s differs" % (i, key)
+
+
+@pytest.mark.slow
 def test_multihost_multiclass_fused_matches_general(tmp_path):
     """Round-5 multi-host MULTICLASS fusion: the class-wise-scan
     shard_map step over a 2-process mesh must produce byte-identical
@@ -638,6 +698,70 @@ def test_multihost_multiclass_fused_matches_general(tmp_path):
     assert m_fused.count("Tree=") == 9   # 3 iterations x 3 classes
     assert m_fused == m_general, \
         "fused multi-host multiclass diverged from the general path"
+
+
+@pytest.mark.slow
+def test_multihost_rank_fused_matches_general(tmp_path):
+    """The tentpole's multi-host leg: lambdarank under tree_learner=data
+    runs the QUERY-SHARDED fused step over a 2-process mesh — each
+    process's lottery shard (whole queries) places into per-shard query
+    blocks, gradients never leave the device, and a transfer audit in
+    the worker proves steady per-iteration host traffic is O(packed
+    tree), NOT the O(rows) grad/hess round trips of the general path.
+    Models must be byte-identical to the forced general path (same
+    device gradient impl, hist_dtype=float64) and across ranks."""
+    import os
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(21)
+    n, ncol = 1500, 5
+    x = rng.randn(n, ncol)
+    rel = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.5 * rng.randn(n)
+    y = np.clip(np.round(rel + 1.5), 0, 4).astype(int)
+    data = tmp_path / "rank.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+    sizes, tot, i = [], 0, 0
+    cycle = [9, 1, 25, 16, 4, 40, 2, 23]
+    while tot < n:
+        sz = min(cycle[i % len(cycle)], n - tot)
+        sizes.append(sz)
+        tot += sz
+        i += 1
+    (tmp_path / "rank.tsv.query").write_text(
+        "\n".join(map(str, sizes)) + "\n")
+    worker = os.path.join(os.path.dirname(__file__), "mh_rank_worker.py")
+    env = {k2: v for k2, v in os.environ.items()
+           if k2 not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def run_cluster(mode):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+        s.close()
+        outs = [str(tmp_path / ("model_%s_%d.txt" % (mode, r)))
+                for r in range(2)]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", port, str(data),
+             outs[r], mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(2)]
+        logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+        for r, p in enumerate(procs):
+            assert p.returncode == 0, "worker %d (%s) failed:\n%s" % (
+                r, mode, logs[r])
+        m0, m1 = open(outs[0]).read(), open(outs[1]).read()
+        assert m0 == m1, "ranks saved different models (%s)" % mode
+        return m0
+
+    m_fused = run_cluster("fused")
+    m_general = run_cluster("general")
+    assert m_fused.count("Tree=") == 3
+    assert m_fused == m_general, \
+        "fused multi-host rank diverged from the general path"
 
 
 @pytest.mark.slow
@@ -1107,37 +1231,149 @@ def test_multiclass_data_parallel_fused_matches_serial():
         np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
 
 
-def test_lambdarank_data_parallel_matches_serial():
-    """Lambdarank + tree_learner=data: the objective's query-block
-    grad_state cannot shard along the data axis (row_shardable=False),
-    so the booster must route through the GENERAL sharded path — and
-    still grow the same trees as serial."""
-    import lightgbm_tpu as lgb
-    n = 8192
-    rng = np.random.RandomState(11)
-    x = rng.randn(n, 6).astype(np.float32)
+def _rank_case(n=8192, seed=11, nfeat=6):
+    """Synthetic ranking data with IRREGULAR query sizes (including
+    1-doc queries) — the shapes the query-granular shard layout must
+    place without ever splitting a query across shards."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nfeat).astype(np.float32)
     rel = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.5 * rng.randn(n)
     y = np.clip(np.round(rel + 1.5), 0, 4).astype(np.float32)
-    group = np.full(n // 16, 16, dtype=np.int32)
-    common = {"objective": "lambdarank", "num_leaves": 15, "max_bin": 63,
-              "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
-              "hist_dtype": "float64"}
+    sizes, tot, i = [], 0, 0
+    cycle = [1, 7, 16, 33, 5, 64, 2, 24]
+    while tot < n:
+        s = min(cycle[i % len(cycle)], n - tot)
+        sizes.append(s)
+        tot += s
+        i += 1
+    return x, y, np.asarray(sizes, dtype=np.int32)
+
+
+RANK_COMMON = {"objective": "lambdarank", "num_leaves": 15, "max_bin": 63,
+               "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": "",
+               "hist_dtype": "float64"}
+
+
+def test_lambdarank_data_parallel_fused_matches_serial():
+    """Lambdarank + tree_learner=data runs the FUSED shard_map step:
+    rows shard query-granularly (no query straddles a shard), each
+    shard's [Q, Lmax] gradient state carries SHARD-LOCAL doc indices,
+    and the trained model must be BYTE-IDENTICAL to the serial device
+    path's (hist_dtype=float64; per-query lambdas are independent of
+    the shard blocking).  Query-granular bagging composes on top (the
+    file-order mt19937 draw scatters into the layout per re-bag)."""
+    import lightgbm_tpu as lgb
+    x, y, group = _rank_case()
+    common = {**RANK_COMMON, "bagging_fraction": 0.8, "bagging_freq": 2}
+    b_serial = lgb.train(common, lgb.Dataset(x, label=y, group=group),
+                         num_boost_round=5, verbose_eval=False)
+    b_data = lgb.train({**common, "tree_learner": "data",
+                        "num_shards": 8},
+                       lgb.Dataset(x, label=y, group=group),
+                       num_boost_round=5, verbose_eval=False)
+    gbdt = b_data._gbdt
+    assert gbdt._can_fuse() and gbdt._fused_sharded, \
+        "device lambdarank + tree_learner=data must take the fused " \
+        "sharded step"
+    assert gbdt._layout_active and gbdt._shard_layout is not None
+    assert len(gbdt.models) == 5
+    assert b_data.model_to_string() == b_serial.model_to_string(), \
+        "fused query-sharded rank model must be byte-identical to serial"
+
+    # degenerate shapes: fewer queries than shards leaves some shards
+    # with zero queries (all-gap blocks); parity must hold regardless
+    xs, ys, gs = _rank_case(n=60, seed=3)
+    gs = np.asarray([25, 1, 34], dtype=np.int32)
+    small = {**RANK_COMMON, "num_leaves": 4, "min_data_in_leaf": 5}
+    a = lgb.train(small, lgb.Dataset(xs, label=ys, group=gs),
+                  num_boost_round=3, verbose_eval=False)
+    b = lgb.train({**small, "tree_learner": "data", "num_shards": 8},
+                  lgb.Dataset(xs, label=ys, group=gs),
+                  num_boost_round=3, verbose_eval=False)
+    assert b._gbdt._can_fuse() and b._gbdt._layout_active
+    assert a.model_to_string() == b.model_to_string()
+
+
+def test_lambdarank_fused_layout_custom_grad_roundtrip():
+    """Leaving the fused query-granular layout for custom gradients
+    (train_one_iter(grad, hess) restores per-row state to FILE order)
+    and coming back (_ensure_layout re-places) must stay byte-identical
+    to a serial booster fed the same sequence."""
+    import lightgbm_tpu as lgb
+    x, y, group = _rank_case(n=4096, seed=5)
+    rng = np.random.RandomState(17)
+    grad = rng.randn(len(y)).astype(np.float32)
+    hess = (rng.rand(len(y)) + 0.5).astype(np.float32)
+
+    def run(extra):
+        bst = lgb.Booster({**RANK_COMMON, **extra},
+                          lgb.Dataset(x, label=y, group=group))
+        g = bst._gbdt
+        for _ in range(2):
+            g.train_one_iter(None, None, False)
+        g.train_one_iter(grad, hess, False)
+        for _ in range(2):
+            g.train_one_iter(None, None, False)
+        return bst, g
+
+    bs, _ = run({})
+    bd, gd = run({"tree_learner": "data", "num_shards": 8})
+    # back on the fused layout path after the custom-gradient excursion
+    assert gd._can_fuse() and gd._layout_active
+    assert len(gd.models) == 5
+    assert bs.model_to_string() == bd.model_to_string()
+
+
+def test_lambdarank_data_parallel_checkpoint_resume():
+    """Exact-state checkpointing under the fused query-sharded rank
+    path: a restored booster continues bit-for-bit (scores re-place
+    into the layout from the FILE-order snapshot; the query-sharded
+    gradient state rebuilds device-side)."""
+    import lightgbm_tpu as lgb
+    x, y, group = _rank_case(n=4096, seed=7)
+    params = {**RANK_COMMON, "tree_learner": "data", "num_shards": 8,
+              "bagging_fraction": 0.8, "bagging_freq": 2}
+
+    def mk():
+        return lgb.Booster(params, lgb.Dataset(x, label=y, group=group))
+
+    a = mk()
+    for _ in range(6):
+        a._gbdt.train_one_iter(None, None, False)
+    b = mk()
+    for _ in range(3):
+        b._gbdt.train_one_iter(None, None, False)
+    import tempfile, os as _os
+    d = tempfile.mkdtemp()
+    ckpt = _os.path.join(d, "rank.ckpt")
+    b._gbdt.save_checkpoint(ckpt)
+    c = mk()
+    c._gbdt.load_checkpoint(ckpt)
+    assert c._gbdt._layout_active
+    for _ in range(3):
+        c._gbdt.train_one_iter(None, None, False)
+    assert c.model_to_string() == a.model_to_string()
+
+
+def test_lambdarank_native_impl_keeps_general_path():
+    """rank_impl=native (the bit-parity oracle) is NOT row-shardable:
+    tree_learner=data must route it through the general per-tree path
+    (host gradients), exactly as before the fused rank step — and still
+    match the serial native path\'s trees."""
+    import lightgbm_tpu as lgb
+    x, y, group = _rank_case(n=2048, seed=2)
+    common = {**RANK_COMMON, "rank_impl": "native"}
     b_serial = lgb.train(common, lgb.Dataset(x, label=y, group=group),
                          num_boost_round=3, verbose_eval=False)
     b_data = lgb.train({**common, "tree_learner": "data",
-                        "num_shards": 2},
+                        "num_shards": 8},
                        lgb.Dataset(x, label=y, group=group),
                        num_boost_round=3, verbose_eval=False)
     gbdt = b_data._gbdt
     assert not gbdt._can_fuse(), \
-        "rank grad_state is not row-shardable; must not take the " \
-        "sharded fused step"
-    assert len(b_serial._gbdt.models) == len(gbdt.models) == 3
-    for t1, t2 in zip(b_serial._gbdt.models, gbdt.models):
-        np.testing.assert_array_equal(t1.split_feature_real,
-                                      t2.split_feature_real)
-        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin)
-        np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count)
+        "rank_impl=native must keep the general data-parallel path"
+    assert gbdt._shard_layout is None
+    assert b_data.model_to_string() == b_serial.model_to_string()
 
 
 def test_feature_parallel_split_traffic_is_packed():
