@@ -398,6 +398,196 @@ def run_predict_e2e(model_path):
             "predict_vs_baseline": round(ref_s / ours_s, 4)}
 
 
+# -- task=serve closed-loop benchmark (serving/ tentpole) ---------------
+
+SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 16))
+SERVE_REQS = int(os.environ.get("BENCH_SERVE_REQS", 150))
+SERVE_ROWS_PER_REQ = int(os.environ.get("BENCH_SERVE_ROWS", 4))
+SERVE_TREES = 100
+SERVE_LEAVES = 63
+
+
+def _serve_model_text(num_trees=SERVE_TREES, num_leaves=SERVE_LEAVES,
+                      num_feat=N_FEAT, seed=11):
+    """Synthetic balanced forest in the reference text format: the
+    serving bench needs a bench-shaped model (100 trees x 63 leaves)
+    without paying a training run."""
+    rng = np.random.RandomState(seed)
+    out = ["gbdt", "num_class=1", "label_index=0",
+           "max_feature_idx=%d" % (num_feat - 1), "sigmoid=1",
+           "objective=binary", ""]
+    for t in range(num_trees):
+        nl = num_leaves
+        sf = np.zeros(nl - 1, dtype=np.int64)
+        thr = np.zeros(nl - 1)
+        lc = np.zeros(nl - 1, dtype=np.int64)
+        rc = np.zeros(nl - 1, dtype=np.int64)
+        state = {"node": 0, "leaf": 0}
+
+        def build(k):
+            if k == 1:
+                leaf = state["leaf"]
+                state["leaf"] += 1
+                return ~leaf
+            i = state["node"]
+            state["node"] += 1
+            sf[i] = rng.randint(num_feat)
+            thr[i] = rng.randn()
+            left = build(k // 2)
+            right = build(k - k // 2)
+            lc[i], rc[i] = left, right
+            return i
+
+        build(nl)
+        lv = rng.randn(nl) * 0.05
+        out += ["Tree=%d" % t,
+                "num_leaves=%d" % nl,
+                "split_feature=" + " ".join(str(v) for v in sf),
+                "split_gain=" + " ".join("1" for _ in sf),
+                "threshold=" + " ".join("%g" % v for v in thr),
+                "left_child=" + " ".join(str(v) for v in lc),
+                "right_child=" + " ".join(str(v) for v in rc),
+                "leaf_parent=" + " ".join("0" for _ in range(nl)),
+                "leaf_value=" + " ".join("%g" % v for v in lv),
+                "internal_value=" + " ".join("0" for _ in sf),
+                ""]
+    out += ["feature importance:", ""]
+    return "\n".join(out)
+
+
+def _serve_round(port_params, bodies, warm_reqs=10):
+    """Start a task=serve subprocess, drive SERVE_CLIENTS closed-loop
+    client threads (1-row requests, keep-alive), return
+    (latencies_s, responses_per_client, wall_s)."""
+    import http.client
+    import signal as sig
+    import socket
+    import threading
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # log to a file, not a PIPE: nothing drains a pipe during the run,
+    # so a chatty server would fill it and block mid-benchmark
+    log_path = os.path.join(CACHE, "bench_serve_server.log")
+    log_f = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+         "serve_port=%d" % port, *port_params],
+        env=env, stdout=log_f, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while True:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=5)
+                c.request("GET", "/healthz")
+                if c.getresponse().read():
+                    c.close()
+                    break
+            except OSError:
+                if proc.poll() is not None or time.time() > deadline:
+                    log_f.flush()
+                    with open(log_path) as lf:
+                        tail = lf.read()[-2000:]
+                    raise RuntimeError(
+                        "serve process did not come up:\n" + tail)
+                time.sleep(0.1)
+
+        lat = [[] for _ in range(SERVE_CLIENTS)]
+        resp = [set() for _ in range(SERVE_CLIENTS)]
+        errs = []
+
+        def client(ci):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.connect()
+                # headers and body go out as two writes; without
+                # TCP_NODELAY Nagle holds the second for the delayed ACK
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                body = bodies[ci % len(bodies)]
+                for _ in range(warm_reqs):
+                    conn.request("POST", "/predict", body)
+                    conn.getresponse().read()
+                for _ in range(SERVE_REQS):
+                    t0 = time.monotonic()
+                    conn.request("POST", "/predict", body)
+                    out = conn.getresponse().read()
+                    lat[ci].append(time.monotonic() - t0)
+                    resp[ci].add(out)
+                conn.close()
+            except Exception as ex:
+                errs.append(ex)
+
+        ts = [threading.Thread(target=client, args=(ci,))
+              for ci in range(SERVE_CLIENTS)]
+        t_all = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.time() - t_all
+        if errs:
+            raise RuntimeError("serve clients failed: %r" % errs[:3])
+        return [v for ls in lat for v in ls], resp, wall
+    finally:
+        proc.send_signal(sig.SIGTERM)
+        try:
+            proc.wait(30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log_f.close()
+
+
+def run_serving_bench():
+    """Closed-loop task=serve throughput + latency, micro-batching ON
+    vs batch-size-1 dispatch (serve_max_batch_rows=1), same clients,
+    byte-equal responses required."""
+    os.makedirs(CACHE, exist_ok=True)
+    model = os.path.join(CACHE, "bench_serve_model.txt")
+    if not os.path.exists(model):
+        with open(model, "w") as f:
+            f.write(_serve_model_text())
+    rng = np.random.RandomState(SEED + 9)
+    bodies = []
+    for _ in range(SERVE_CLIENTS):
+        rows = rng.randn(SERVE_ROWS_PER_REQ, N_FEAT)
+        bodies.append("".join(
+            "0\t" + "\t".join("%.6g" % v for v in row) + "\n"
+            for row in rows).encode())
+    common = ["input_model=" + model, "metric_freq=100", "verbose=0"]
+    lat_b, resp_b, wall_b = _serve_round(
+        common + ["serve_max_batch_rows=4096",
+                  "serve_batch_timeout_ms=2"], bodies)
+    lat_1, resp_1, wall_1 = _serve_round(
+        common + ["serve_max_batch_rows=1",
+                  "serve_batch_timeout_ms=0"], bodies)
+    # equal correctness: every client saw EXACTLY one distinct response
+    # per mode, and the same bytes in both modes
+    for ci in range(SERVE_CLIENTS):
+        assert len(resp_b[ci]) == 1 and resp_b[ci] == resp_1[ci], \
+            "serving responses diverged between batching modes"
+    n = SERVE_CLIENTS * SERVE_REQS * SERVE_ROWS_PER_REQ
+    lat_b.sort()
+    lat_1.sort()
+    return {
+        "serve_rows_per_s": round(n / wall_b, 1),
+        "serve_p50_ms": round(lat_b[len(lat_b) // 2] * 1e3, 3),
+        "serve_p99_ms": round(lat_b[int(len(lat_b) * 0.99)] * 1e3, 3),
+        "serve_batch1_rows_per_s": round(n / wall_1, 1),
+        "serve_batch1_p50_ms": round(lat_1[len(lat_1) // 2] * 1e3, 3),
+        "serve_batch1_p99_ms": round(lat_1[int(len(lat_1) * 0.99)] * 1e3,
+                                     3),
+        "serve_batch_speedup": round(wall_1 / wall_b, 4),
+        "serve_clients": SERVE_CLIENTS,
+        "serve_rows_per_req": SERVE_ROWS_PER_REQ,
+    }
+
+
 def ensure_ref_binary():
     exe = os.path.join(REF_BUILD, "ref_src", "lightgbm")
     if os.path.exists(exe):
@@ -721,6 +911,16 @@ def main():
         except Exception as e:
             extras["dart_error"] = str(e)[:200]
 
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        # online-serving family (serving/): closed-loop throughput +
+        # p50/p99, micro-batching vs per-request dispatch — the
+        # subsystem's headline is the batching speedup at identical
+        # response bytes
+        try:
+            extras.update(run_serving_bench())
+        except Exception as e:
+            extras["serve_error"] = str(e)[:200]
+
     if os.environ.get("BENCH_PREDICT", "1") != "0":
         if predict_extras is None:
             try:
@@ -745,6 +945,9 @@ def main():
         # file-to-file predict has no chunked loop; both sides are
         # single-shot walls (ours best-of-2 against tunnel stalls)
         conventions["predict_vs_baseline"] = "wall"
+    if "serve_batch_speedup" in extras:
+        # closed-loop client wall on both sides (batched vs batch-1)
+        conventions["serve_batch_speedup"] = "wall"
     print(json.dumps({
         "metric": "train_100trees_1Mx28",
         "value": round(ours["train_total_s"], 3),

@@ -51,6 +51,14 @@ class Application:
             self._apply_device_type()
             self.init_train()
             self.train()
+        elif self.config.task == "serve":
+            # warm-model HTTP prediction service (serving/): jax imports
+            # lazily inside the forest only when its engine is selected,
+            # so serve_backend=native keeps the jax-free startup profile
+            if self.config.serve_backend != "native":
+                self._apply_device_type()
+            from .serving.server import serve_forever
+            serve_forever(self.config)
         else:
             if not os.environ.get("LGBM_TPU_NO_FAST_PREDICT"):
                 from .predict_fast import try_fast_predict
@@ -252,7 +260,8 @@ class Application:
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        from .io.parser import parse_file_lines
+        from .io.parser import parse_predict_rows
+        from .predict_fast import format_pred_rows
 
         cfg = self.config
         log.info("Started prediction...")
@@ -285,48 +294,27 @@ class Application:
 
         fmt = [None]
 
-        def parse(lines):
-            # dense blocks parse at the MODEL's width (+1 for the label
-            # column): the reference Predictor reads every field of every
-            # line and drops only feature indices >= num_features
-            # (parser.hpp:20-43, predictor.hpp PutFeatureValuesToBuffer),
-            # so ragged rows — shorter OR wider than the first — behave
-            # exactly like the reference's (and the native fast path's)
-            _, feats, f = parse_file_lines(
-                lines, label_idx, fmt[0],
-                dense_cols=max(n_total_feat + 1, label_idx + 1))
+        def parse(feats_lines):
+            # model-width parse shared with serving (the reference
+            # Predictor's every-field + drop-past-num_features rule,
+            # io/parser.parse_predict_rows)
+            feats, f = parse_predict_rows(feats_lines, label_idx,
+                                          n_total_feat, fmt[0])
             fmt[0] = f  # sniff once, reuse for every later block
-            # libsvm blocks vary with their own max index; normalize to
-            # the model's width so one compiled traversal executable
-            # covers every block
-            if feats.shape[1] < n_total_feat:
-                feats = np.pad(
-                    feats, ((0, 0), (0, n_total_feat - feats.shape[1])))
-            elif feats.shape[1] > n_total_feat:
-                feats = feats[:, :n_total_feat]
             return feats
 
         def format_block(feats) -> bytes:
+            # output formatting shared with serving
+            # (predict_fast.format_pred_rows: native bulk %g /
+            # tab-joined leaf ids)
             if cfg.is_predict_leaf_index:
-                out = booster.predict_leaf_index(feats)      # [N, T]
-                return ("\n".join(
-                    "\t".join(str(int(v)) for v in row) for row in out)
-                    + "\n").encode()
+                return format_pred_rows(
+                    booster.predict_leaf_index(feats), True)  # [N, T]
             if cfg.is_predict_raw_score:
                 res = booster.predict_raw(feats)             # [K, N]
             else:
                 res = booster.predict(feats)
-            # bulk native %g (byte-identical to Python's "%g" for finite
-            # doubles; Predictor::SaveTextPredictionsToFile role) — the
-            # per-value Python loop was a measured chunk of predict wall
-            from . import native
-            rows = np.ascontiguousarray(res.T)               # [N, K]
-            blob = native.format_g(rows)
-            if blob is not None:
-                return blob
-            return ("\n".join(
-                "\t".join("%g" % v for v in res[:, i])
-                for i in range(res.shape[1])) + "\n").encode()
+            return format_pred_rows(res, False)
 
         gen = blocks()
         # pull the first block BEFORE opening (truncating) the output file

@@ -102,7 +102,7 @@ class Config:
     dataclass is the idiomatic Python equivalent)."""
 
     # -- task / top-level ------------------------------------------------
-    task: str = "train"                   # train | predict
+    task: str = "train"                   # train | predict | serve
     num_threads: int = 0
     boosting_type: str = "gbdt"           # gbdt | dart
     objective: str = "regression"         # regression | binary | multiclass | lambdarank
@@ -197,6 +197,13 @@ class Config:
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
+    # -- online serving (task=serve; serving/) ---------------------------
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 8080                # 0 = pick a free port
+    serve_max_batch_rows: int = 8192      # rows per coalesced dispatch
+    serve_batch_timeout_ms: float = 2.0   # micro-batching window
+    serve_backend: str = "auto"           # auto | jax | native
+
     # ---------------------------------------------------------------------
     @staticmethod
     def from_params(params: Dict[str, str]) -> "Config":
@@ -228,6 +235,8 @@ class Config:
                 c.task = "train"
             elif t in ("predict", "prediction", "test"):
                 c.task = "predict"
+            elif t in ("serve", "serving"):
+                c.task = "serve"
             else:
                 log.fatal("Unknown task type %s" % t)
         if "boosting_type" in params:
@@ -337,6 +346,18 @@ class Config:
         set_int("hist_reorder_every")
         set_bool("donate_buffers")
         set_str("device_type")
+        set_str("serve_host")
+        set_int("serve_port")
+        set_int("serve_max_batch_rows")
+        set_float("serve_batch_timeout_ms")
+        set_str("serve_backend")
+        if c.serve_backend not in ("auto", "jax", "native"):
+            log.fatal("Unknown serve_backend %s (expect auto|jax|native)"
+                      % c.serve_backend)
+        if c.serve_max_batch_rows < 1:
+            log.fatal("serve_max_batch_rows must be >= 1")
+        if c.serve_batch_timeout_ms < 0:
+            log.fatal("serve_batch_timeout_ms must be >= 0")
         if c.device_type not in ("", "cpu", "tpu"):
             log.fatal("Unknown device_type %s (expect cpu|tpu)"
                       % c.device_type)

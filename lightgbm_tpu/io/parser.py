@@ -228,6 +228,28 @@ def parse_file_lines(lines: List[str], label_idx: int,
     return label, feats, fmt
 
 
+def parse_predict_rows(lines: List[str], label_idx: int,
+                       num_total_feat: int, fmt: Optional[str] = None
+                       ) -> Tuple[np.ndarray, str]:
+    """Prediction-input parse at the MODEL's width, the ONE home of the
+    rule cli.predict and the serving subsystem share: dense rows parse
+    at max(num_total_feat + 1, label_idx + 1) columns (the reference
+    Predictor reads every field and drops only feature indices >=
+    num_features, parser.hpp:20-43 + predictor.hpp), and libsvm blocks
+    — which size to their own max index — normalize to num_total_feat
+    (absent trailing features read 0.0, extras drop).  Returns
+    (feats [N, num_total_feat] f64, fmt)."""
+    _, feats, f = parse_file_lines(
+        lines, label_idx, fmt,
+        dense_cols=max(num_total_feat + 1, label_idx + 1))
+    if feats.shape[1] < num_total_feat:
+        feats = np.pad(feats,
+                       ((0, 0), (0, num_total_feat - feats.shape[1])))
+    elif feats.shape[1] > num_total_feat:
+        feats = feats[:, :num_total_feat]
+    return feats, f
+
+
 def parse_file_bytes(raw: bytes, label_idx: int,
                      fmt: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray, str]:

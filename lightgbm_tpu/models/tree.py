@@ -147,6 +147,17 @@ class Tree:
         return ~node
 
 
+def select_used_trees(trees: List["Tree"], num_class: int,
+                      num_model_predict: int) -> List["Tree"]:
+    """set_num_used_model resolution, shared by the native predict fast
+    path and serving: num_model_predict counts ITERATIONS, each holding
+    num_class trees (gbdt.cpp:455-456); < 0 keeps everything."""
+    num_used = len(trees) // num_class
+    if num_model_predict >= 0:
+        num_used = min(num_model_predict, num_used)
+    return trees[:num_used * num_class]
+
+
 def parse_model_text(model_str: str):
     """Model text -> (header dict, [Tree]) — the jax-free core of
     GBDT::LoadModelFromString (reference gbdt.cpp:402-456), shared by

@@ -39,6 +39,35 @@ from .utils import log
 CHUNK_BYTES = 64 << 20
 
 
+def format_pred_rows(res: "np.ndarray", leaf: bool) -> bytes:
+    """Predict results -> output bytes, the ONE home of the prediction
+    output format (Predictor::SaveTextPredictionsToFile role), shared by
+    cli.predict's streaming blocks and the serving subsystem so the two
+    cannot drift: leaf mode tab-joins integer leaf ids per row; score
+    mode is bulk native "%g" (byte-identical to Python's "%g" for
+    finite doubles) with the Python loop as the no-toolchain fallback.
+
+    res: [N, T] leaf indices when leaf, else [K, N] scores.  0-row
+    input returns b"" (the serving 0-row contract; cli blocks are never
+    empty)."""
+    if leaf:
+        if res.shape[0] == 0:
+            return b""
+        return ("\n".join(
+            "\t".join(str(int(v)) for v in row) for row in res)
+            + "\n").encode()
+    if res.shape[1] == 0:
+        return b""
+    from . import native
+    rows = np.ascontiguousarray(res.T)               # [N, K]
+    blob = native.format_g(rows)
+    if blob is not None:
+        return blob
+    return ("\n".join(
+        "\t".join("%g" % v for v in res[:, i])
+        for i in range(res.shape[1])) + "\n").encode()
+
+
 class _LightModel:
     """Model-text header + trees, parsed without models.gbdt (which
     imports jax).  The actual reader is models.tree.parse_model_text,
@@ -56,22 +85,44 @@ class _LightModel:
         self.trees: List[Tree] = trees
 
     def used_trees(self, num_model_predict: int) -> List[Tree]:
-        """cli.init_predict's set_num_used_model call, resolved:
-        num_model_predict counts ITERATIONS; each holds num_class
-        trees (gbdt.cpp:455-456)."""
-        num_used = len(self.trees) // self.num_class
-        if num_model_predict >= 0:
-            num_used = min(num_model_predict, num_used)
-        return self.trees[:num_used * self.num_class]
+        """cli.init_predict's set_num_used_model call, resolved
+        (models.tree.select_used_trees, shared with serving)."""
+        from .models.tree import select_used_trees
+        return select_used_trees(self.trees, self.num_class,
+                                 num_model_predict)
 
 
 def _read_chunks(path: str, has_header: bool):
     """Yield line-aligned byte chunks of the input file, skipping the
     first NON-blank line when has_header (matching io/dataset
-    _skip_header and cli.predict's blocks())."""
+    _skip_header and cli.predict's blocks()).
+
+    The header skip runs BEFORE chunking starts and carries the partial
+    header across reads explicitly, so a header line longer than
+    CHUNK_BYTES (or preceded by blank lines) can never truncate data:
+    the old interleaved skip left that guarantee implicit in the
+    chunk-boundary handling (test_predict_fast pins the regression)."""
     with open(path, "rb") as f:
         carry = b""
-        skip_header = has_header
+        skip = has_header
+        while skip:
+            block = f.read(CHUNK_BYTES)
+            if not block:
+                return  # whole file is the header (or blanks): no rows
+            carry += block
+            pos = 0
+            while True:
+                eol = carry.find(b"\n", pos)
+                if eol < 0:
+                    # header (or leading blanks) continue into the next
+                    # read: keep the partial line as the carry
+                    carry = carry[pos:]
+                    break
+                if carry[pos:eol].strip(b"\r"):
+                    carry = carry[eol + 1:]   # past the header line
+                    skip = False
+                    break
+                pos = eol + 1                 # blank line: keep looking
         while True:
             block = f.read(CHUNK_BYTES)
             if not block:
@@ -82,41 +133,38 @@ def _read_chunks(path: str, has_header: bool):
                 carry = buf
                 continue
             chunk, carry = buf[:cut + 1], buf[cut + 1:]
-            if skip_header:
-                chunk, skipped = _strip_header(chunk)
-                if not skipped:
-                    continue  # header line longer than the chunk: rare
-                skip_header = False
             yield chunk
-        if carry:
-            if skip_header:
-                carry, skipped = _strip_header(carry)
-                if not skipped:
-                    return
-            if carry.strip(b"\r\n"):
-                yield carry
+        if carry.strip(b"\r\n"):
+            yield carry
 
 
-def _strip_header(chunk: bytes) -> Tuple[bytes, bool]:
-    """Drop the first non-blank line; (rest, found)."""
-    pos = 0
-    while pos < len(chunk):
-        eol = chunk.find(b"\n", pos)
-        end = eol if eol >= 0 else len(chunk)
-        if chunk[pos:end].strip(b"\r"):
-            return (chunk[end + 1:] if eol >= 0 else b""), True
-        if eol < 0:
-            break
-        pos = eol + 1
-    return b"", False
+# bytes per _sniff_format read; the sniff keeps reading past this until
+# it has complete data lines (a header alone can exceed one read)
+SNIFF_BYTES = 1 << 20
 
 
 def _sniff_format(path: str, has_header: bool) -> Tuple[str, str]:
-    """(fmt, sep) from the first data lines (Parser::CreateParser role)."""
+    """(fmt, sep) from the first data lines (Parser::CreateParser role).
+
+    Reads until it holds (header +) two COMPLETE non-blank lines — a
+    single fixed-size read once misdetected the format when the header
+    line was longer than the read, because the partial header was
+    dropped as if it were the whole header and whatever followed (or
+    nothing) was sniffed instead."""
+    need = 2 + (1 if has_header else 0)
+    buf = b""
     with open(path, "rb") as f:
-        head = f.read(1 << 20)
-    lines = [ln for ln in head.decode("utf-8", "replace").splitlines()
-             if ln.strip("\r")]
+        while True:
+            block = f.read(SNIFF_BYTES)
+            buf += block
+            eof = not block
+            # only complete lines count unless EOF ended the last one
+            cut = len(buf) if eof else buf.rfind(b"\n") + 1
+            lines = [ln for ln in
+                     buf[:cut].decode("utf-8", "replace").splitlines()
+                     if ln.strip("\r")]
+            if eof or len(lines) >= need:
+                break
     if has_header and lines:
         lines = lines[1:]
     fmt = detect_format(lines[:2])

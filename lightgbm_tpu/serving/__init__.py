@@ -1,0 +1,38 @@
+"""Online serving subsystem: warm-forest prediction over HTTP.
+
+The reference serves prediction from one warm process (a parse ->
+descend -> format loop over a resident model, src/application/
+predictor.hpp:82-130); this package is that loop turned into a service:
+
+  forest.py   ServingForest — model text parsed once (shared
+              models.tree.parse_model_text reader), flattened to
+              contiguous arrays, kept device-resident with bucketed
+              pre-compiled predict dispatches; JAX-free fallback through
+              native.predict_chunk / the numpy descent.
+  batcher.py  MicroBatcher — coalesces concurrent requests into one
+              dispatch under (max_batch_rows, batch_timeout_ms) and
+              scatters results back, bit-identical to solo requests.
+  server.py   stdlib HTTP server: POST /predict, GET /healthz,
+              GET /metrics (Prometheus text), POST /reload (atomic hot
+              model swap), graceful drain on SIGTERM.
+
+Selected by `task=serve` through the CLI (cli.py / config.py).
+"""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - annotation-only re-exports
+    from .batcher import MicroBatcher  # noqa: F401
+    from .forest import ServingForest  # noqa: F401
+
+__all__ = ["ServingForest", "MicroBatcher"]
+
+
+def __getattr__(name):  # PEP 562 lazy exports, like the package root
+    if name == "ServingForest":
+        from .forest import ServingForest
+        return ServingForest
+    if name == "MicroBatcher":
+        from .batcher import MicroBatcher
+        return MicroBatcher
+    raise AttributeError(name)

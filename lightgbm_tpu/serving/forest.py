@@ -1,0 +1,303 @@
+"""Warm, device-resident forest for online serving.
+
+Parses model text ONCE through the shared `models.tree.parse_model_text`
+reader (the same one GBDT.load_model_from_string and the native predict
+fast path use, so the three cannot drift), flattens the trees to
+contiguous arrays, and answers batch predict calls with no per-request
+model work:
+
+  - JAX engine (default when the jax stack imports): the stacked
+    [T, M] node arrays live on the default device and every batch runs
+    one `ops.predict.predict_leaf_stacked` dispatch.  Rows pad up to
+    power-of-two buckets (`bucket_rows`) and `warm()` pre-compiles every
+    bucket up to `serve_max_batch_rows`, so steady-state requests never
+    recompile regardless of batch size.  Score accumulation stays on the
+    host in f64 (boosting order), byte-identical to `task=predict`.
+  - host engine (JAX-free fallback, `serve_backend=native` or jax
+    unavailable): raw CSV/TSV request text goes through the fused
+    native kernel (`native.predict_chunk` — parse -> descend ->
+    transform -> "%g" in one multithreaded pass), and parsed float rows
+    (JSON requests) take the vectorized numpy descent with the same
+    exact f64 `<=` routing and accumulation order.
+
+Output formatting (`format_rows`) replicates cli.predict's format_block
+byte-for-byte: native "%g" bulk formatting when available, Python "%g"
+otherwise (identical for finite doubles).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..models.tree import Tree, parse_model_text
+from ..utils import log
+
+MODES = ("normal", "raw", "leaf")
+
+# smallest compiled row bucket: tiny interactive requests share one
+# executable instead of compiling per row count
+BUCKET_FLOOR = 16
+
+
+def bucket_rows(n: int, floor: int = BUCKET_FLOOR) -> int:
+    """Power-of-two row bucket for a batch of n rows (>= floor)."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ServingForest:
+    """One loaded model, ready to answer predict batches.
+
+    Immutable after construction + warm(): hot swap builds a NEW
+    ServingForest off to the side and swaps the reference (server.py),
+    so no locking is needed on the predict path.
+    """
+
+    def __init__(self, model_text: str, num_model_predict: int = -1,
+                 backend: str = "auto", source: str = "<string>"):
+        header, trees = parse_model_text(model_text)
+        self.num_class: int = header["num_class"]
+        self.label_idx: int = header["label_index"]
+        self.max_feature_idx: int = header["max_feature_idx"]
+        # prediction-only sigmoid default, like cli.init_predict's GBDT
+        # (no binary objective configured -> -1)
+        self.sigmoid: float = (header["sigmoid"]
+                               if header["sigmoid"] is not None else -1.0)
+        # set_num_used_model resolution shared with the predict fast
+        # path (models.tree.select_used_trees)
+        from ..models.tree import select_used_trees
+        self.trees: List[Tree] = select_used_trees(
+            trees, self.num_class, num_model_predict)
+        self.num_models = len(self.trees)
+        self.source = source
+        self.loaded_at = time.time()
+
+        self._engine = self._pick_engine(backend)
+        self._lock = threading.Lock()   # guards lazy pack builds only
+        self._jax_pack = None
+        self._native_spec = None
+        self._native_spec_tried = False
+        self._host_pack = None
+        if self._engine == "jax":
+            self._build_jax_pack()
+
+    # -- engine selection ----------------------------------------------
+    @staticmethod
+    def _pick_engine(backend: str) -> str:
+        if backend == "native":
+            return "host"
+        if backend == "jax":
+            import jax  # noqa: F401  (raises when truly unavailable)
+            return "jax"
+        try:
+            import jax  # noqa: F401
+            return "jax"
+        except Exception:
+            return "host"
+
+    @property
+    def engine(self) -> str:
+        return self._engine
+
+    # -- packed representations ----------------------------------------
+    def _flat_arrays(self):
+        """[T, M] padded node arrays + [T, L] leaf values (the
+        GBDT._stacked_trees layout, rebuilt here without a jax import)."""
+        trees = self.trees
+        t = len(trees)
+        max_l = max((tr.num_leaves for tr in trees), default=1)
+        m = max(1, max_l - 1)
+        sf = np.zeros((t, m), dtype=np.int32)
+        thr = np.zeros((t, m), dtype=np.float64)
+        lc = np.full((t, m), -1, dtype=np.int32)
+        rc = np.full((t, m), -1, dtype=np.int32)
+        lv = np.zeros((t, max_l), dtype=np.float64)
+        for i, tr in enumerate(trees):
+            ni = tr.num_leaves - 1
+            if ni > 0:
+                sf[i, :ni] = tr.split_feature_real[:ni]
+                thr[i, :ni] = tr.threshold[:ni]
+                lc[i, :ni] = tr.left_child[:ni]
+                rc[i, :ni] = tr.right_child[:ni]
+            # ni == 0 keeps lc[i, 0] == -1 == ~0: every row -> leaf 0
+            lv[i, :tr.num_leaves] = tr.leaf_value[:tr.num_leaves]
+        return sf, thr, lc, rc, lv
+
+    def _build_jax_pack(self):
+        if self._jax_pack is not None:
+            return self._jax_pack
+        with self._lock:
+            if self._jax_pack is None:
+                import jax.numpy as jnp
+                from ..ops.predict import split_hi_lo
+                sf, thr, lc, rc, lv = self._flat_arrays()
+                th, tl = split_hi_lo(thr)
+                dev = tuple(jnp.asarray(a)
+                            for a in (sf, th, tl, lc, rc))
+                self._jax_pack = {"dev": dev, "lv": lv}
+        return self._jax_pack
+
+    def _build_host_pack(self):
+        if self._host_pack is not None:
+            return self._host_pack
+        with self._lock:
+            if self._host_pack is None:
+                _, _, _, _, lv = self._flat_arrays()
+                self._host_pack = {"lv": lv}
+        return self._host_pack
+
+    def _native_forest(self):
+        """native.ForestSpec for the fused text kernel, or None."""
+        if not self._native_spec_tried:
+            with self._lock:
+                if not self._native_spec_tried:
+                    from .. import native
+                    if self.trees and native.get_lib() is not None:
+                        self._native_spec = native.ForestSpec(
+                            self.trees, self.num_class, self.sigmoid)
+                    self._native_spec_tried = True
+        return self._native_spec
+
+    # -- prediction ------------------------------------------------------
+    def fit_width(self, x: np.ndarray) -> np.ndarray:
+        """Pad/truncate to the model's feature width: absent trailing
+        features read 0.0, extra columns drop (predictor.hpp's
+        p.first < num_features rule)."""
+        x = np.asarray(x, dtype=np.float64)
+        if x.ndim != 2:
+            raise ValueError("feature rows must be 2-D, got %r"
+                             % (x.shape,))
+        want = self.max_feature_idx + 1
+        if x.shape[1] < want:
+            x = np.pad(x, ((0, 0), (0, want - x.shape[1])))
+        elif x.shape[1] > want:
+            x = x[:, :want]
+        return x
+
+    def _leaves(self, x: np.ndarray) -> np.ndarray:
+        """[N, F] f64 -> [N, T] leaf indices, one dispatch (JAX engine)
+        or the vectorized numpy descent (host engine) — identical f64
+        `value <= threshold` routing either way."""
+        n = x.shape[0]
+        if self._engine == "jax":
+            import jax.numpy as jnp
+            from ..ops.predict import predict_leaf_stacked, split_hi_lo
+            pack = self._build_jax_pack()
+            b = bucket_rows(n)
+            if b > n:
+                x = np.pad(x, ((0, b - n), (0, 0)))
+            xh, xl = split_hi_lo(x)
+            leaves = predict_leaf_stacked(*pack["dev"], jnp.asarray(xh),
+                                          jnp.asarray(xl))
+            return np.asarray(leaves)[:n]
+        out = np.empty((n, self.num_models), dtype=np.int64)
+        for i, tr in enumerate(self.trees):
+            out[:, i] = tr.predict_leaf_index(x)
+        return out
+
+    def predict(self, x: np.ndarray, mode: str) -> np.ndarray:
+        """Batch predict on parsed rows.  mode 'leaf' -> [N, T] int;
+        'raw'/'normal' -> [K, N] f64 (normal applies sigmoid/softmax,
+        the exact GBDT.predict expressions)."""
+        if mode not in MODES:
+            raise ValueError("unknown predict mode %r" % mode)
+        x = self.fit_width(x)
+        n = x.shape[0]
+        k = self.num_class
+        t = self.num_models
+        if mode == "leaf":
+            if n == 0 or t == 0:
+                return np.zeros((n, t), dtype=np.int64)
+            return self._leaves(x)
+        if n == 0 or t == 0:
+            raw = np.zeros((k, n), dtype=np.float64)
+        else:
+            leaves = self._leaves(x)
+            lv = (self._build_jax_pack() if self._engine == "jax"
+                  else self._build_host_pack())["lv"]
+            raw = np.zeros((k, n), dtype=np.float64)
+            # per-tree f64 accumulation in boosting order, exactly the
+            # reference predictor's += tree->Predict (predictor.hpp:35-70)
+            for i in range(t):
+                raw[i % k] += lv[i, leaves[:, i]]
+        if mode == "raw":
+            return raw
+        if self.sigmoid > 0:
+            return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * raw))
+        if k > 1:
+            e = np.exp(raw - raw.max(axis=0, keepdims=True))
+            return e / e.sum(axis=0, keepdims=True)
+        return raw
+
+    def predict_text(self, text: bytes, fmt: str, sep: str,
+                     mode: str) -> Optional[Tuple[bytes, int]]:
+        """Fused native pass over raw request lines (header already
+        stripped): (formatted bytes, rows), or None when the native
+        kernel is unavailable/refuses — callers parse + predict()
+        instead.  This is the JAX-free fallback the host engine serves
+        CSV/TSV requests through (predict_fast's warm loop, request-
+        sized)."""
+        spec = self._native_forest()
+        if spec is None:
+            return None
+        from .. import native
+        mode_i = {"normal": 0, "raw": 1, "leaf": 2}[mode]
+        return native.predict_chunk(text, fmt, sep, self.label_idx,
+                                    self.max_feature_idx + 1, spec, mode_i)
+
+    def format_rows(self, res: np.ndarray, mode: str) -> bytes:
+        """Result array -> response bytes through the SAME formatter as
+        cli.predict's blocks (predict_fast.format_pred_rows), so served
+        bytes cannot drift from task=predict's."""
+        from ..predict_fast import format_pred_rows
+        return format_pred_rows(res, mode == "leaf")
+
+    # -- warm-up ---------------------------------------------------------
+    def warm(self, max_batch_rows: int) -> int:
+        """Pre-compile every power-of-two row bucket up to
+        max_batch_rows (JAX engine; the host engine just builds its
+        packs).  Returns the number of compiled buckets so callers can
+        log/measure."""
+        if self._engine != "jax":
+            self._build_host_pack()
+            self._native_forest()
+            return 0
+        n_buckets = 0
+        b = BUCKET_FLOOR
+        while True:
+            dummy = np.zeros((min(b, max_batch_rows),
+                              self.max_feature_idx + 1))
+            self.predict(dummy, "raw")
+            n_buckets += 1
+            if b >= max_batch_rows:
+                break
+            b <<= 1
+        return n_buckets
+
+    # -- introspection ---------------------------------------------------
+    def info(self) -> dict:
+        return {
+            "source": self.source,
+            "engine": self._engine,
+            "num_models": self.num_models,
+            "num_class": self.num_class,
+            "max_feature_idx": self.max_feature_idx,
+            "loaded_at": self.loaded_at,
+        }
+
+
+def load_forest(path: str, num_model_predict: int = -1,
+                backend: str = "auto") -> ServingForest:
+    """Read + parse + pack a model file (no warm-up; callers warm)."""
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        log.fatal("Model file %s is empty" % path)
+    return ServingForest(text, num_model_predict=num_model_predict,
+                         backend=backend, source=path)

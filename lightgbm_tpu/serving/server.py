@@ -1,0 +1,584 @@
+"""`task=serve`: the warm-model HTTP prediction server.
+
+Endpoints:
+  POST /predict[?mode=normal|raw|leaf][&header=0|1]
+        Body: rows in the task=predict data-file format (CSV/TSV/LibSVM,
+        label column included at the model's label_index) or JSON
+        feature rows ({"rows": [[...], ...]} / bare [[...]] — no label
+        column, the c_api matrix-predict convention).  Response bytes
+        are identical to what `task=predict` writes for the same rows
+        (tests/test_serving.py pins it against the golden predict
+        outputs).  A 0-row body returns an empty 200 body.
+  GET  /healthz     liveness + loaded-model info (JSON)
+  GET  /metrics     Prometheus text: request/row/batch counters,
+                    latency + batch-size histograms, in-flight gauge
+  POST /reload      atomic hot model swap: {"model": "<path>"} (default:
+                    the configured input_model).  The new forest parses
+                    and warms off to the side; in-flight requests finish
+                    on the old forest (batches key on the forest object).
+
+Graceful drain: SIGTERM/SIGINT stop the listener, finish queued
+batches, then exit — no request is dropped mid-flight.
+
+Everything is stdlib (http.server threading model: one handler thread
+per connection, blocked in MicroBatcher.submit while its rows ride a
+coalesced dispatch).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..config import Config
+from ..io.parser import detect_format, parse_predict_rows
+from ..utils import log
+from .batcher import BatcherClosed, MicroBatcher, RowsPayload, TextPayload
+from .forest import MODES, ServingForest, load_forest
+
+MAX_BODY_BYTES = 256 << 20   # refuse absurd request bodies outright
+
+
+# ---------------------------------------------------------------------------
+# Prometheus metrics (text exposition format, no client library needed)
+# ---------------------------------------------------------------------------
+
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+_BATCH_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                      2048, 4096, 8192, 16384)
+
+
+class _Histogram:
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)   # +inf tail
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, help_: str, out: List[str]) -> None:
+        out.append("# HELP %s %s" % (name, help_))
+        out.append("# TYPE %s histogram" % name)
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append('%s_bucket{le="%g"} %d' % (name, b, cum))
+        cum += self.counts[-1]
+        out.append('%s_bucket{le="+Inf"} %d' % (name, cum))
+        out.append("%s_sum %g" % (name, self.sum))
+        out.append("%s_count %d" % (name, cum))
+
+
+class Metrics:
+    """Thread-safe serving metrics, rendered in Prometheus text format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        self.requests = {}           # (endpoint, code) -> count
+        self.rows_total = 0
+        self.batches_total = 0
+        self.reloads_total = 0
+        self.in_flight = 0
+        self.latency = _Histogram(_LATENCY_BUCKETS)
+        self.batch_rows = _Histogram(_BATCH_ROW_BUCKETS)
+
+    def request_started(self, endpoint: str) -> None:
+        # the gauge tracks PREDICT work in flight; a /metrics scrape
+        # must not count itself
+        if endpoint == "/predict":
+            with self._lock:
+                self.in_flight += 1
+
+    def request_finished(self, endpoint: str, code: int,
+                         seconds: float, rows: int = 0) -> None:
+        with self._lock:
+            if endpoint == "/predict":
+                self.in_flight -= 1
+            key = (endpoint, code)
+            self.requests[key] = self.requests.get(key, 0) + 1
+            self.rows_total += rows
+            if endpoint == "/predict" and code == 200:
+                self.latency.observe(seconds)
+
+    def batch_dispatched(self, n_items: int, n_rows: int) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.batch_rows.observe(n_rows)
+
+    def reloaded(self) -> None:
+        with self._lock:
+            self.reloads_total += 1
+
+    def render(self, forest: ServingForest) -> bytes:
+        out: List[str] = []
+        with self._lock:
+            out.append("# HELP lgbm_serve_requests_total "
+                       "HTTP requests by endpoint and status code")
+            out.append("# TYPE lgbm_serve_requests_total counter")
+            for (ep, code), n in sorted(self.requests.items()):
+                out.append('lgbm_serve_requests_total{endpoint="%s",'
+                           'code="%d"} %d' % (ep, code, n))
+            out.append("# HELP lgbm_serve_rows_total "
+                       "prediction rows served")
+            out.append("# TYPE lgbm_serve_rows_total counter")
+            out.append("lgbm_serve_rows_total %d" % self.rows_total)
+            out.append("# HELP lgbm_serve_batches_total "
+                       "coalesced predict dispatches")
+            out.append("# TYPE lgbm_serve_batches_total counter")
+            out.append("lgbm_serve_batches_total %d" % self.batches_total)
+            out.append("# HELP lgbm_serve_reloads_total "
+                       "successful hot model swaps")
+            out.append("# TYPE lgbm_serve_reloads_total counter")
+            out.append("lgbm_serve_reloads_total %d" % self.reloads_total)
+            out.append("# HELP lgbm_serve_in_flight "
+                       "requests currently being handled")
+            out.append("# TYPE lgbm_serve_in_flight gauge")
+            out.append("lgbm_serve_in_flight %d" % self.in_flight)
+            out.append("# HELP lgbm_serve_model_loaded_timestamp_seconds "
+                       "unix time the live model was loaded")
+            out.append("# TYPE lgbm_serve_model_loaded_timestamp_seconds "
+                       "gauge")
+            out.append("lgbm_serve_model_loaded_timestamp_seconds %g"
+                       % forest.loaded_at)
+            out.append("# HELP lgbm_serve_model_num_trees "
+                       "tree count of the live model")
+            out.append("# TYPE lgbm_serve_model_num_trees gauge")
+            out.append("lgbm_serve_model_num_trees %d" % forest.num_models)
+            self.latency.render("lgbm_serve_request_latency_seconds",
+                                "predict request latency", out)
+            self.batch_rows.render("lgbm_serve_batch_rows",
+                                   "rows per coalesced dispatch", out)
+        return ("\n".join(out) + "\n").encode()
+
+
+# ---------------------------------------------------------------------------
+# Request body -> batcher payload
+# ---------------------------------------------------------------------------
+
+class BadRequest(ValueError):
+    status = 400
+
+
+class LengthRequired(BadRequest):
+    status = 411
+
+
+def _strip_first_line(text: bytes) -> bytes:
+    """Drop the first non-blank line (request-level has_header)."""
+    pos = 0
+    while pos < len(text):
+        eol = text.find(b"\n", pos)
+        end = len(text) if eol < 0 else eol
+        if text[pos:end].strip(b"\r"):
+            return text[end + 1:] if eol >= 0 else b""
+        if eol < 0:
+            break
+        pos = eol + 1
+    return b""
+
+
+def _parse_json_rows(body: bytes) -> np.ndarray:
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as ex:
+        raise BadRequest("invalid JSON body: %s" % ex)
+    rows = doc.get("rows") if isinstance(doc, dict) else doc
+    if not isinstance(rows, list):
+        raise BadRequest('JSON body must be {"rows": [[...], ...]} '
+                         "or a bare list of rows")
+    if not rows:
+        return np.zeros((0, 0), dtype=np.float64)
+    try:
+        feats = np.asarray(rows, dtype=np.float64)
+    except (TypeError, ValueError) as ex:
+        raise BadRequest("rows must be numeric lists: %s" % ex)
+    if feats.ndim != 2:
+        raise BadRequest("rows must be a list of equal-length lists")
+    return feats
+
+
+def _parse_text_rows(body: bytes, forest: ServingForest) -> np.ndarray:
+    """Data-file lines -> [N, F_model] f64 through the SAME model-width
+    parse as cli.predict (io/parser.parse_predict_rows)."""
+    lines = [ln for ln in body.decode("utf-8", "replace").splitlines()
+             if ln.strip("\r")]
+    n_total_feat = forest.max_feature_idx + 1
+    if not lines:
+        return np.zeros((0, n_total_feat), dtype=np.float64)
+    feats, _ = parse_predict_rows(lines, forest.label_idx, n_total_feat)
+    return feats
+
+
+def _sniff_sep(body: bytes) -> Tuple[str, str]:
+    head = [ln for ln in body[:65536].decode("utf-8", "replace").splitlines()
+            if ln.strip("\r")]
+    fmt = detect_format(head[:2])
+    return fmt, ("," if fmt == "csv" else "\t")
+
+
+# ---------------------------------------------------------------------------
+# Serving state: forest + batcher + metrics, hot-swappable
+# ---------------------------------------------------------------------------
+
+class ServingState:
+    def __init__(self, cfg: Config, forest: ServingForest):
+        self.cfg = cfg
+        self.metrics = Metrics()
+        self._forest = forest
+        self._swap_lock = threading.Lock()   # serializes /reload only
+        self.draining = False
+        self.batcher = MicroBatcher(
+            self._run_batch, cfg.serve_max_batch_rows,
+            cfg.serve_batch_timeout_ms,
+            on_batch=self.metrics.batch_dispatched)
+
+    @property
+    def forest(self) -> ServingForest:
+        return self._forest
+
+    # -- the coalesced dispatch (MicroBatcher worker thread) -----------
+    # Batches key on (forest, mode, family): the forest object isolates
+    # hot-swap in-flight traffic, and the family keeps text requests of
+    # different formats (csv vs tsv vs libsvm) — which cannot share one
+    # native pass — out of each other's dispatches.
+    def _run_batch(self, key, payloads) -> List:
+        forest, mode, family = key
+        if family[0] == "text":
+            total = sum(p.nrows for p in payloads)
+            if total:
+                fmt, sep = family[1], family[2]
+                try:
+                    # host engine: ONE fused native pass over the joined
+                    # request lines (each payload's text is newline-
+                    # terminated by construction)
+                    got = forest.predict_text(
+                        b"".join(p.text for p in payloads), fmt, sep,
+                        mode)
+                except log.LightGBMError:
+                    # a malformed token somewhere in the batch: redo
+                    # per item below so only the offender fails
+                    got = None
+                if got is not None:
+                    blob, rows = got
+                    if rows != total:
+                        raise RuntimeError(
+                            "native predict returned %d rows for %d "
+                            "input lines" % (rows, total))
+                    return _split_lines(blob,
+                                        [p.nrows for p in payloads])
+            # no native kernel, 0 rows, or isolating a bad request:
+            # parse + numeric path per item (errors stay per-item)
+            out: List = []
+            for p in payloads:
+                try:
+                    feats = _parse_text_rows(p.text, forest)
+                    res = forest.predict(feats, mode)
+                    out.append(forest.format_rows(res, mode))
+                except log.LightGBMError as ex:
+                    out.append(ex)
+            return out
+        feats = [forest.fit_width(p.feats) for p in payloads]
+        counts = [f.shape[0] for f in feats]
+        batch = (np.concatenate(feats, axis=0) if len(feats) > 1
+                 else feats[0])
+        res = forest.predict(batch, mode)
+        blob = forest.format_rows(res, mode)
+        return _split_lines(blob, counts)
+
+    # -- hot swap -------------------------------------------------------
+    def reload(self, model_path: str) -> dict:
+        with self._swap_lock:
+            fresh = load_forest(model_path,
+                                num_model_predict=self.cfg.num_model_predict,
+                                backend=self.cfg.serve_backend)
+            fresh.warm(self.cfg.serve_max_batch_rows)
+            old = self._forest
+            self._forest = fresh   # atomic reference swap; in-flight
+            #                        batches keep keying on `old`
+            self.metrics.reloaded()
+            log.info("Hot-swapped model %s (%d trees) -> %s (%d trees)"
+                     % (old.source, old.num_models, fresh.source,
+                        fresh.num_models))
+            return fresh.info()
+
+
+def _split_lines(blob: bytes, counts: List[int]) -> List[bytes]:
+    """Split newline-terminated output back per request segment (every
+    predict mode emits exactly one line per row)."""
+    parts = []
+    pos = 0
+    for c in counts:
+        if c == 0:
+            parts.append(b"")
+            continue
+        end = pos
+        for _ in range(c):
+            nl = blob.find(b"\n", end)
+            if nl < 0:
+                end = len(blob)
+                break
+            end = nl + 1
+        parts.append(blob[pos:end])
+        pos = end
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+def _make_handler(state: ServingState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # one buffered write per response + TCP_NODELAY: the default
+        # unbuffered wfile emits headers as separate segments, and
+        # Nagle x delayed-ACK turns that into ~40 ms per keep-alive
+        # round trip on loopback (measured: p50 42 ms -> sub-10 ms)
+        wbufsize = 1 << 16
+        disable_nagle_algorithm = True
+
+        def log_message(self, fmt, *args):  # route through our logger
+            log.debug("serve: " + fmt % args)
+
+        def _respond(self, code: int, body: bytes,
+                     ctype: str = "text/plain; charset=utf-8") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> bytes:
+            if "chunked" in (self.headers.get("Transfer-Encoding")
+                             or "").lower():
+                # we only read Content-Length bodies; an unread chunked
+                # body would desync the next keep-alive request, so
+                # refuse AND drop the connection after responding
+                self.close_connection = True
+                raise LengthRequired(
+                    "chunked request bodies are not supported; send "
+                    "Content-Length")
+            n = int(self.headers.get("Content-Length") or 0)
+            if n > MAX_BODY_BYTES:
+                self.close_connection = True   # body stays unread
+                raise BadRequest("request body too large (%d bytes)" % n)
+            return self.rfile.read(n) if n else b""
+
+        # -- GET ---------------------------------------------------------
+        def do_GET(self):
+            t0 = time.monotonic()
+            path = urlparse(self.path).path
+            state.metrics.request_started(path)
+            code = 200
+            try:
+                if path == "/healthz":
+                    doc = {"status": "draining" if state.draining
+                           else "ok",
+                           "uptime_s": round(
+                               time.time() - state.metrics.started_at, 3),
+                           "model": state.forest.info()}
+                    self._respond(200, json.dumps(doc).encode(),
+                                  "application/json")
+                elif path == "/metrics":
+                    self._respond(
+                        200, state.metrics.render(state.forest),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    code = 404
+                    self._respond(404, b"not found\n")
+            finally:
+                state.metrics.request_finished(path, code,
+                                               time.monotonic() - t0)
+
+        # -- POST --------------------------------------------------------
+        def do_POST(self):
+            t0 = time.monotonic()
+            url = urlparse(self.path)
+            path = url.path
+            state.metrics.request_started(path)
+            code, rows = 200, 0
+            try:
+                if path == "/predict":
+                    code, rows = self._predict(url)
+                elif path == "/reload":
+                    code = self._reload()
+                else:
+                    code = 404
+                    self._respond(404, b"not found\n")
+            except (BadRequest, log.LightGBMError) as ex:
+                # LightGBMError here is a data error (e.g. an unknown
+                # token while parsing the request body): client fault
+                code = getattr(ex, "status", 400)
+                self._respond(code, (str(ex) + "\n").encode())
+            except Exception as ex:
+                code = 500
+                log.warning("serve: internal error: %s" % ex)
+                self._respond(500, (str(ex) + "\n").encode())
+            finally:
+                state.metrics.request_finished(path, code,
+                                               time.monotonic() - t0,
+                                               rows)
+
+        def _predict(self, url) -> Tuple[int, int]:
+            # read the body FIRST even on early-exit paths: an unread
+            # body desyncs the next request on a keep-alive connection
+            body = self._body()
+            if state.draining:
+                self._respond(503, b"draining\n")
+                return 503, 0
+            q = parse_qs(url.query)
+            mode = q.get("mode", ["normal"])[0].lower()
+            if mode not in MODES:
+                raise BadRequest("unknown mode %r (expect normal|raw|"
+                                 "leaf)" % mode)
+            ctype = (self.headers.get("Content-Type") or "").lower()
+            forest = state.forest   # pin ONE forest for this request
+            if "json" in ctype:
+                payload = RowsPayload(_parse_json_rows(body))
+                family = ("rows",)
+            else:
+                has_header = _qbool(q, "header", state.cfg.has_header)
+                if has_header:
+                    body = _strip_first_line(body)
+                if body and not body.endswith(b"\n"):
+                    body += b"\n"
+                if forest.engine == "jax":
+                    payload = RowsPayload(_parse_text_rows(body, forest))
+                    family = ("rows",)
+                else:
+                    fmt, sep = _sniff_sep(body)
+                    payload = TextPayload(body, fmt, sep)
+                    family = ("text", fmt, sep)
+            nrows = payload.nrows
+            try:
+                parts = state.batcher.submit((forest, mode, family),
+                                             payload)
+            except BatcherClosed:
+                # raced the drain past the flag check above
+                self._respond(503, b"draining\n")
+                return 503, 0
+            except log.LightGBMError as ex:
+                raise BadRequest(str(ex))
+            self._respond(200, b"".join(parts))
+            return 200, nrows
+
+        def _reload(self) -> int:
+            body = self._body()
+            path = state.cfg.input_model
+            if body.strip():
+                try:
+                    doc = json.loads(body.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError) as ex:
+                    raise BadRequest("invalid JSON body: %s" % ex)
+                if isinstance(doc, dict) and doc.get("model"):
+                    path = str(doc["model"])
+            if not path:
+                raise BadRequest("no model path: configure input_model "
+                                 'or POST {"model": "<path>"}')
+            try:
+                info = state.reload(path)
+            except (OSError, log.LightGBMError) as ex:
+                raise BadRequest("reload failed: %s" % ex)
+            self._respond(200, json.dumps(info).encode(),
+                          "application/json")
+            return 200
+
+    return Handler
+
+
+def _qbool(q, key: str, default: bool) -> bool:
+    if key not in q:
+        return default
+    return q[key][0].strip().lower() in ("1", "true", "+", "yes")
+
+
+class ServingServer:
+    """Constructed server, not yet draining — tests/bench drive this
+    directly; the CLI wraps it in serve_forever()."""
+
+    def __init__(self, cfg: Config, forest: Optional[ServingForest] = None):
+        if forest is None:
+            if not cfg.input_model:
+                log.fatal("Need a model file for serving (input_model)")
+            forest = load_forest(cfg.input_model,
+                                 num_model_predict=cfg.num_model_predict,
+                                 backend=cfg.serve_backend)
+        t0 = time.time()
+        n_buckets = forest.warm(cfg.serve_max_batch_rows)
+        log.info("Warmed %s serving forest (%d trees, %d row buckets) "
+                 "in %.3f s" % (forest.engine, forest.num_models,
+                                n_buckets, time.time() - t0))
+        self.state = ServingState(cfg, forest)
+        self.httpd = ThreadingHTTPServer((cfg.serve_host, cfg.serve_port),
+                                         _make_handler(self.state))
+        self.httpd.daemon_threads = True
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return "http://%s:%d" % (host, port)
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever(poll_interval=0.1)
+
+    def shutdown(self, drain_timeout: float = 30.0) -> None:
+        """Graceful drain: stop accepting, finish queued work, then
+        wait for the handler threads to WRITE their responses (they are
+        daemon threads — exiting while one is mid-write would reset the
+        client connection)."""
+        self.state.draining = True
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.state.batcher.shutdown()
+        deadline = time.monotonic() + drain_timeout
+        while (self.state.metrics.in_flight > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+
+
+def serve_forever(cfg: Config) -> None:
+    """CLI entry (task=serve): run until SIGTERM/SIGINT, then drain."""
+    server = ServingServer(cfg)
+    host, port = server.address
+    log.info("Serving %s on http://%s:%d (max_batch_rows=%d, "
+             "batch_timeout_ms=%g)"
+             % (server.state.forest.source, host, port,
+                cfg.serve_max_batch_rows, cfg.serve_batch_timeout_ms))
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info("Signal %d: draining..." % signum)
+        stop.set()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _on_signal)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        stop.wait()
+    finally:
+        for sig, h in prev.items():
+            signal.signal(sig, h)
+        server.shutdown()
+        t.join(10)
+        log.info("Serve drained, exiting")

@@ -1,0 +1,156 @@
+#!/bin/bash
+# serve_smoke.sh — end-to-end smoke of the task=serve subsystem:
+# start the server, round-trip one predict (bytes must equal
+# task=predict's), scrape /metrics, hot-swap via /reload (bytes must
+# equal task=predict under the NEW model), then SIGTERM-drain.
+# Exits nonzero on any mismatch.  Stdlib-only clients (no curl).
+#
+# Usage: scripts/serve_smoke.sh        (from the repo root or anywhere)
+
+set -u
+here="$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)"
+PY="${PYTHON:-python3}"
+export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+work="$(mktemp -d)"
+server_pid=""
+cleanup() {
+    [ -n "$server_pid" ] && kill -9 "$server_pid" 2>/dev/null
+    rm -rf "$work"
+}
+trap cleanup EXIT
+
+die() { echo "serve_smoke: FAIL: $*" >&2; exit 1; }
+
+# -- fixture: two tiny models + a request body -------------------------
+"$PY" - "$work" <<'EOF' || die "fixture generation"
+import sys, numpy as np
+work = sys.argv[1]
+model = """gbdt
+num_class=1
+label_index=0
+max_feature_idx=3
+sigmoid=1
+objective=binary
+
+Tree=0
+num_leaves=3
+split_feature=0 2
+split_gain=1 0.5
+threshold=0.5 -0.25
+left_child=1 -2
+right_child=-1 -3
+leaf_parent=0 1 1
+leaf_value=0.2 -0.13 0.34
+internal_value=0 0.1
+
+feature importance:
+"""
+open(work + "/model_a.txt", "w").write(model)
+open(work + "/model_b.txt", "w").write(
+    model.replace("leaf_value=0.2 -0.13 0.34",
+                  "leaf_value=0.7 -0.6 0.5"))
+rng = np.random.RandomState(0)
+with open(work + "/data.tsv", "w") as f:
+    for row in rng.randn(25, 4):
+        f.write("0\t" + "\t".join("%.6g" % v for v in row) + "\n")
+EOF
+
+# -- expected bytes via the batch path ---------------------------------
+for m in a b; do
+    "$PY" -m lightgbm_tpu task=predict "data=$work/data.tsv" \
+        "input_model=$work/model_$m.txt" \
+        "output_result=$work/want_$m.txt" verbose=0 \
+        || die "task=predict ($m)"
+done
+
+# -- start the server --------------------------------------------------
+port="$("$PY" -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+"$PY" -m lightgbm_tpu task=serve "input_model=$work/model_a.txt" \
+    "serve_port=$port" serve_batch_timeout_ms=1 \
+    > "$work/server.log" 2>&1 &
+server_pid=$!
+
+"$PY" - "$port" <<'EOF' || { cat "$work/server.log" >&2; die "server did not come up"; }
+import sys, time, urllib.request
+port = sys.argv[1]
+deadline = time.time() + 120
+while time.time() < deadline:
+    try:
+        urllib.request.urlopen("http://127.0.0.1:%s/healthz" % port,
+                               timeout=2).read()
+        sys.exit(0)
+    except OSError:
+        time.sleep(0.2)
+sys.exit(1)
+EOF
+
+# -- predict round trip + /metrics + /reload ---------------------------
+"$PY" - "$port" "$work" <<'EOF' || { cat "$work/server.log" >&2; exit 1; }
+import json, sys, urllib.request
+port, work = sys.argv[1], sys.argv[2]
+base = "http://127.0.0.1:%s" % port
+
+def post(path, data, ctype="text/plain"):
+    req = urllib.request.Request(base + path, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+def fail(msg):
+    sys.stderr.write("serve_smoke: FAIL: %s\n" % msg)
+    sys.exit(1)
+
+body = open(work + "/data.tsv", "rb").read()
+want_a = open(work + "/want_a.txt", "rb").read()
+want_b = open(work + "/want_b.txt", "rb").read()
+
+got = post("/predict", body)
+if got != want_a:
+    fail("served bytes differ from task=predict (model A)")
+
+metrics = urllib.request.urlopen(base + "/metrics", timeout=60).read().decode()
+for needle in ("lgbm_serve_rows_total 25",
+               'lgbm_serve_requests_total{endpoint="/predict",code="200"} 1',
+               "lgbm_serve_batches_total",
+               "lgbm_serve_request_latency_seconds_count"):
+    if needle not in metrics:
+        fail("metrics scrape missing %r" % needle)
+
+info = json.loads(post("/reload",
+                       json.dumps({"model": work + "/model_b.txt"}).encode(),
+                       "application/json"))
+if info.get("source") != work + "/model_b.txt":
+    fail("reload did not report the new model: %r" % info)
+
+got = post("/predict", body)
+if got != want_b:
+    fail("post-reload bytes differ from task=predict (model B)")
+if got == want_a:
+    fail("reload did not change predictions")
+
+health = json.loads(urllib.request.urlopen(base + "/healthz",
+                                           timeout=60).read())
+if health.get("status") != "ok":
+    fail("healthz not ok after reload: %r" % health)
+print("serve_smoke: predict + metrics + reload OK")
+EOF
+rc=$?
+[ "$rc" -eq 0 ] || die "round trip (rc=$rc)"
+
+# -- graceful drain ----------------------------------------------------
+kill -TERM "$server_pid"
+for _ in $(seq 1 100); do
+    kill -0 "$server_pid" 2>/dev/null || break
+    sleep 0.1
+done
+if kill -0 "$server_pid" 2>/dev/null; then
+    die "server did not drain within 10s of SIGTERM"
+fi
+wait "$server_pid"
+rc=$?
+server_pid=""
+[ "$rc" -eq 0 ] || die "server exited nonzero on SIGTERM drain (rc=$rc)"
+
+echo "serve_smoke: PASS"

@@ -286,6 +286,69 @@ def test_empty_input_no_clobber(tmp_path):
         assert f.read() == "precious"
 
 
+def test_header_longer_than_chunk_keeps_all_rows(tmp_path, monkeypatch):
+    """Regression: a header line longer than CHUNK_BYTES (optionally
+    preceded by blank lines) must not truncate data — the partial header
+    carries across chunk reads explicitly (_read_chunks's pre-chunking
+    skip loop)."""
+    import lightgbm_tpu.predict_fast as pf
+
+    rows = _rows(n=97)
+    header = "\t".join("column_with_a_very_long_name_%d" % i
+                       for i in range(200))
+    with open(tmp_path / "d.tsv", "w") as f:
+        f.write("\n\n")           # leading blank lines before the header
+        f.write(header + "\n")
+        for r in rows:
+            f.write("\t".join(r) + "\n")
+    assert len(header) > (1 << 10)
+    monkeypatch.setattr(pf, "CHUNK_BYTES", 1 << 10)
+    fast, slow = _run_both(tmp_path, BINARY_MODEL, "d.tsv",
+                           ("header=true",))
+    assert fast == slow
+    assert len(fast.splitlines()) == 97
+
+
+def test_read_chunks_unit_header_spans_many_chunks(tmp_path, monkeypatch):
+    """_read_chunks directly: every chunking of a blank/long-header file
+    yields exactly the data bytes after the header."""
+    import lightgbm_tpu.predict_fast as pf
+
+    data = b"\r\n\n" + b"H" * 100 + b"\n" + b"r1\n\nr2\nr3"
+    path = str(tmp_path / "x.tsv")
+    with open(path, "wb") as f:
+        f.write(data)
+    for cb in (1, 2, 3, 7, 16, 64, 4096):
+        monkeypatch.setattr(pf, "CHUNK_BYTES", cb)
+        got = b"".join(pf._read_chunks(path, True))
+        assert [ln for ln in got.split(b"\n") if ln.strip(b"\r")] \
+            == [b"r1", b"r2", b"r3"], cb
+        # header-only / blank-only files produce no chunks at all
+    with open(path, "wb") as f:
+        f.write(b"\n" + b"H" * 50)
+    monkeypatch.setattr(pf, "CHUNK_BYTES", 8)
+    assert list(pf._read_chunks(path, True)) == []
+
+
+def test_sniff_format_header_longer_than_read(tmp_path, monkeypatch):
+    """Regression: _sniff_format once dropped a PARTIAL header as if it
+    were the whole first line when the header exceeded one read, then
+    sniffed nothing (tsv fallback) — a CSV file behind a long header
+    misparsed.  The sniff now reads until it has complete data lines."""
+    import lightgbm_tpu.predict_fast as pf
+
+    monkeypatch.setattr(pf, "SNIFF_BYTES", 64)
+    with open(tmp_path / "d.csv", "w") as f:
+        f.write("h" * 300 + "\n")
+        f.write("0,1.5,2.5,3.5,4.5\n1,0.5,1.5,2.5,3.5\n")
+    assert pf._sniff_format(str(tmp_path / "d.csv"), True) == ("csv", ",")
+    # end-to-end through the fast path at the small sniff size
+    fast, slow = _run_both(tmp_path, BINARY_MODEL, "d.csv",
+                           ("header=true",))
+    assert fast == slow
+    assert len(fast.splitlines()) == 2
+
+
 def test_tiny_threshold_dense_drop_rule(tmp_path):
     """Dense parsers zero |v| <= 1e-10 (reference parser.hpp:32,62), so a
     value below the cutoff goes LEFT of Tree=1's 1.5e-11 threshold even

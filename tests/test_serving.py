@@ -1,0 +1,546 @@
+"""task=serve HTTP prediction server: served-vs-batch byte parity
+(normal/raw/leaf, binary + multiclass, JAX forest AND native fallback),
+hot model swap, metrics, drain, and the golden predict outputs when the
+reference examples are present.
+
+Every test runs under JAX_PLATFORMS=cpu (conftest) and skips nothing on
+a missing native toolchain except the native-fallback-specific paths —
+the host engine's numpy descent and Python "%g" formatting are
+byte-identical stand-ins, which is itself asserted here.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.serving.forest import ServingForest, bucket_rows
+from lightgbm_tpu.serving.server import ServingServer
+
+from conftest import GOLDEN_DIR, REFERENCE_DIR
+from test_predict_fast import BINARY_MODEL, MULTI_MODEL, _rows
+
+EXAMPLES = os.path.join(REFERENCE_DIR, "examples")
+
+MODE_ARGS = {"normal": (), "raw": ("is_predict_raw_score=true",),
+             "leaf": ("is_predict_leaf_index=true",)}
+
+
+def _write(path, text):
+    mode = "wb" if isinstance(text, bytes) else "w"
+    with open(path, mode) as f:
+        f.write(text)
+    return str(path)
+
+
+def cli_predict(tmp_path, model_path, data_path, mode) -> bytes:
+    out = str(tmp_path / ("cli_%s.txt" % mode))
+    Application(["task=predict", "data=" + data_path,
+                 "input_model=" + model_path, "output_result=" + out,
+                 "device_type=cpu", *MODE_ARGS[mode]]).run()
+    with open(out, "rb") as f:
+        return f.read()
+
+
+@contextmanager
+def serve(model_path, **params):
+    p = {"task": "serve", "input_model": model_path, "serve_port": "0",
+         "serve_max_batch_rows": "64", "serve_batch_timeout_ms": "1"}
+    p.update({k: str(v) for k, v in params.items()})
+    cfg = Config.from_params(p)
+    server = ServingServer(cfg)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        t.join(10)
+
+
+def post(url, path, data, ctype="text/plain", timeout=30):
+    req = urllib.request.Request(url + path, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _tsv_body(rows):
+    return ("\n".join("\t".join(r) for r in rows) + "\n").encode()
+
+
+ENGINES = ["auto", "native"]
+
+
+# ---------------------------------------------------------------------------
+# served-vs-batch parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ENGINES)
+@pytest.mark.parametrize("mode", ["normal", "raw", "leaf"])
+def test_served_matches_batch_predict_binary(tmp_path, backend, mode):
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=150)).decode())
+    want = cli_predict(tmp_path, model, data, mode)
+    with open(data, "rb") as f:
+        body = f.read()
+    with serve(model, serve_backend=backend) as srv:
+        expect = "host" if backend == "native" else "jax"
+        assert srv.state.forest.engine == expect
+        st, got = post(srv.url, "/predict?mode=" + mode, body)
+    assert st == 200
+    assert got == want, "served bytes differ from task=predict (%s/%s)" \
+        % (backend, mode)
+
+
+@pytest.mark.parametrize("backend", ENGINES)
+@pytest.mark.parametrize("mode", ["normal", "raw"])
+def test_served_matches_batch_predict_multiclass(tmp_path, backend, mode):
+    model = _write(tmp_path / "m.txt", MULTI_MODEL)
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=90, f=3)).decode())
+    want = cli_predict(tmp_path, model, data, mode)
+    with open(data, "rb") as f:
+        body = f.read()
+    with serve(model, serve_backend=backend) as srv:
+        st, got = post(srv.url, "/predict?mode=" + mode, body)
+    assert st == 200 and got == want
+
+
+@pytest.mark.parametrize("fmt", ["csv", "libsvm"])
+def test_served_matches_batch_predict_other_formats(tmp_path, fmt):
+    rows = _rows(n=80)
+    if fmt == "csv":
+        body = ("\n".join(",".join(r) for r in rows) + "\n").encode()
+        data = _write(tmp_path / "d.csv", body)
+    else:
+        lines = []
+        for r in rows:
+            pairs = ["%d:%s" % (i, t) for i, t in enumerate(r[1:])
+                     if t != "na"]
+            lines.append(" ".join([r[0]] + pairs))
+        body = ("\n".join(lines) + "\n").encode()
+        data = _write(tmp_path / "d.svm", body)
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    want = cli_predict(tmp_path, model, data, "normal")
+    for backend in ENGINES:
+        with serve(model, serve_backend=backend) as srv:
+            st, got = post(srv.url, "/predict", body)
+        assert st == 200 and got == want, (backend, fmt)
+
+
+@pytest.mark.skipif(not os.path.isdir(EXAMPLES),
+                    reason="reference examples not mounted")
+@pytest.mark.parametrize("example,test_file,model,golden_out,mode", [
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_normal.txt", "normal"),
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_raw.txt", "raw"),
+    ("binary_classification", "binary.test", "golden_binary_model.txt",
+     "pred_binary_leaf.txt", "leaf"),
+    ("multiclass_classification", "multiclass.test",
+     "golden_multiclass_model.txt", "pred_multiclass_normal.txt",
+     "normal"),
+])
+def test_served_matches_golden_predict_outputs(example, test_file, model,
+                                               golden_out, mode):
+    """POST /predict on the reference example inputs must return the
+    EXACT bytes the reference binary wrote (tests/golden/pred_*), through
+    both the JAX forest and the native fallback."""
+    with open(os.path.join(EXAMPLES, example, test_file), "rb") as f:
+        body = f.read()
+    with open(os.path.join(GOLDEN_DIR, golden_out), "rb") as f:
+        want = f.read()
+    model_path = os.path.join(GOLDEN_DIR, model)
+    for backend in ENGINES:
+        with serve(model_path, serve_max_batch_rows=4096,
+                   serve_backend=backend) as srv:
+            st, got = post(srv.url, "/predict?mode=" + mode, body)
+        assert st == 200
+        assert got == want, "served %s/%s diverges from golden %s" \
+            % (backend, mode, golden_out)
+
+
+def test_json_rows_match_text_rows(tmp_path):
+    """JSON feature rows (no label column) produce the same bytes as the
+    equivalent TSV rows with a dummy label column."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(40, 4)
+    tsv = ("\n".join("0\t" + "\t".join(repr(float(v)) for v in row)
+                     for row in x) + "\n").encode()
+    body = json.dumps({"rows": x.tolist()}).encode()
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    for mode in ("normal", "raw", "leaf"):
+        with serve(model) as srv:
+            st_t, out_t = post(srv.url, "/predict?mode=" + mode, tsv)
+            st_j, out_j = post(srv.url, "/predict?mode=" + mode, body,
+                               "application/json")
+        assert st_t == st_j == 200
+        assert out_t == out_j, mode
+
+
+def test_request_header_is_stripped(tmp_path):
+    rows = _rows(n=30)
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    body = _tsv_body(rows)
+    with serve(model) as srv:
+        st, plain = post(srv.url, "/predict", body)
+        st2, with_hdr = post(srv.url, "/predict?header=1",
+                             b"label\tf0\tf1\tf2\tf3\n" + body)
+    assert st == st2 == 200
+    assert plain == with_hdr
+    assert len(plain.splitlines()) == 30
+
+
+@pytest.mark.parametrize("mode", ["normal", "raw", "leaf"])
+def test_zero_row_request_returns_empty_body(tmp_path, mode):
+    """0-row requests return a mode-shaped empty body (the serving
+    analog of the _predict_sparse 0-row contract): 200, zero lines."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        for body, ctype in ((b"", "text/plain"), (b"\n\n\n", "text/plain"),
+                            (b'{"rows": []}', "application/json")):
+            st, out = post(srv.url, "/predict?mode=" + mode, body, ctype)
+            assert st == 200 and out == b"", (body, ctype)
+
+
+def test_oversize_request_splits_and_reassembles(tmp_path):
+    """A request bigger than serve_max_batch_rows must come back whole,
+    in order, byte-identical to batch predict."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=403)).decode())
+    want = cli_predict(tmp_path, model, data, "normal")
+    with open(data, "rb") as f:
+        body = f.read()
+    for backend in ENGINES:
+        with serve(model, serve_max_batch_rows=32,
+                   serve_backend=backend) as srv:
+            st, got = post(srv.url, "/predict", body)
+            _, metrics = get(srv.url, "/metrics")
+        assert st == 200 and got == want, backend
+        batches = int([ln for ln in metrics.decode().splitlines()
+                       if ln.startswith("lgbm_serve_batches_total")]
+                      [0].split()[-1])
+        assert batches >= 403 // 32  # really went through split dispatches
+
+
+def test_concurrent_clients_no_bleed(tmp_path):
+    """N concurrent clients with DISTINCT rows each get exactly their
+    own bytes back while dispatches coalesce."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    n_clients = 12
+    bodies, wants = [], []
+    for i in range(n_clients):
+        rows = _rows(n=10 + i, seed=100 + i)
+        data = _write(tmp_path / ("d%d.tsv" % i),
+                      _tsv_body(rows).decode())
+        bodies.append(_tsv_body(rows))
+        wants.append(cli_predict(tmp_path, model, data, "normal"))
+    with serve(model, serve_batch_timeout_ms=25,
+               serve_max_batch_rows=4096) as srv:
+        start = threading.Barrier(n_clients)
+        got = [None] * n_clients
+        errs = []
+
+        def client(i):
+            try:
+                start.wait()
+                _, got[i] = post(srv.url, "/predict", bodies[i])
+            except Exception as ex:  # pragma: no cover
+                errs.append(ex)
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        _, metrics = get(srv.url, "/metrics")
+    assert not errs
+    for i in range(n_clients):
+        assert got[i] == wants[i], "client %d got foreign bytes" % i
+    m = metrics.decode()
+    rows_total = int([ln for ln in m.splitlines()
+                      if ln.startswith("lgbm_serve_rows_total")]
+                     [0].split()[-1])
+    assert rows_total == sum(10 + i for i in range(n_clients))
+
+
+# ---------------------------------------------------------------------------
+# hot swap / lifecycle / observability
+# ---------------------------------------------------------------------------
+
+def test_reload_swaps_model_atomically(tmp_path):
+    model_a = _write(tmp_path / "a.txt", BINARY_MODEL)
+    model_b = _write(tmp_path / "b.txt", BINARY_MODEL.replace(
+        "leaf_value=0.2 -0.13 0.34", "leaf_value=0.9 -0.9 0.9"))
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=60)).decode())
+    want_a = cli_predict(tmp_path, model_a, data, "normal")
+    want_b = cli_predict(tmp_path, model_b, data, "normal")
+    assert want_a != want_b
+    with open(data, "rb") as f:
+        body = f.read()
+    with serve(model_a) as srv:
+        st, out = post(srv.url, "/predict", body)
+        assert (st, out) == (200, want_a)
+        st, info = post(srv.url, "/reload",
+                        json.dumps({"model": model_b}).encode(),
+                        "application/json")
+        assert st == 200
+        assert json.loads(info)["source"] == model_b
+        st, out = post(srv.url, "/predict", body)
+        assert (st, out) == (200, want_b)
+        # reload of a missing path: 400, the live model stays serving
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv.url, "/reload",
+                 json.dumps({"model": str(tmp_path / "nope.txt")}).encode(),
+                 "application/json")
+        assert ei.value.code == 400
+        st, out = post(srv.url, "/predict", body)
+        assert (st, out) == (200, want_b)
+        _, metrics = get(srv.url, "/metrics")
+    assert "lgbm_serve_reloads_total 1" in metrics.decode()
+
+
+def test_reload_under_concurrent_traffic(tmp_path):
+    """Requests racing a hot swap each get a response wholly from ONE
+    model — never a mix, never an error."""
+    model_a = _write(tmp_path / "a.txt", BINARY_MODEL)
+    model_b = _write(tmp_path / "b.txt", BINARY_MODEL.replace(
+        "leaf_value=0.2 -0.13 0.34", "leaf_value=0.9 -0.9 0.9"))
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=40)).decode())
+    want_a = cli_predict(tmp_path, model_a, data, "normal")
+    want_b = cli_predict(tmp_path, model_b, data, "normal")
+    with open(data, "rb") as f:
+        body = f.read()
+    with serve(model_a, serve_batch_timeout_ms=5) as srv:
+        stop = threading.Event()
+        outs, errs = [], []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    outs.append(post(srv.url, "/predict", body)[1])
+                except Exception as ex:  # pragma: no cover
+                    errs.append(ex)
+
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for target in (model_b, model_a, model_b):
+            post(srv.url, "/reload",
+                 json.dumps({"model": target}).encode(),
+                 "application/json")
+        stop.set()
+        for t in ts:
+            t.join()
+    assert not errs
+    assert outs
+    bad = [o for o in outs if o not in (want_a, want_b)]
+    assert not bad, "got %d responses matching neither model" % len(bad)
+
+
+def test_healthz_and_metrics_shape(tmp_path):
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        st, health = get(srv.url, "/healthz")
+        assert st == 200
+        doc = json.loads(health)
+        assert doc["status"] == "ok"
+        assert doc["model"]["num_models"] == 3
+        post(srv.url, "/predict", _tsv_body(_rows(n=5)))
+        st, metrics = get(srv.url, "/metrics")
+    m = metrics.decode()
+    assert st == 200
+    assert 'lgbm_serve_requests_total{endpoint="/predict",code="200"} 1' in m
+    assert "lgbm_serve_rows_total 5" in m
+    assert "lgbm_serve_in_flight 0" in m
+    assert "lgbm_serve_request_latency_seconds_count 1" in m
+    assert 'lgbm_serve_batch_rows_bucket{le="8"} 1' in m
+    assert "lgbm_serve_model_num_trees 3" in m
+
+
+def test_bad_requests_are_isolated_400s(tmp_path):
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        for body, ctype, q in ((b"not\tnumbers\tat\tall\n", "text/plain",
+                                ""),
+                               (b"{invalid json", "application/json", ""),
+                               (b'{"rows": "x"}', "application/json", ""),
+                               (b"1\t2\n", "text/plain", "?mode=bogus")):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(srv.url, "/predict" + q, body, ctype)
+            assert ei.value.code == 400, body
+        # server still healthy afterwards
+        st, out = post(srv.url, "/predict", _tsv_body(_rows(n=3)))
+        assert st == 200 and len(out.splitlines()) == 3
+
+
+def test_chunked_body_is_refused_cleanly(tmp_path):
+    """Transfer-Encoding: chunked bodies are refused with 411 and the
+    connection drops (an unread chunked body would desync keep-alive);
+    the server keeps serving normal requests afterwards."""
+    import http.client
+
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        host, port = srv.address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.putrequest("POST", "/predict")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"4\r\n1\t2\r\n0\r\n\r\n")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 411, body
+        conn.close()
+        st, out = post(srv.url, "/predict", _tsv_body(_rows(n=4)))
+        assert st == 200 and len(out.splitlines()) == 4
+
+
+def test_drain_finishes_inflight_work(tmp_path):
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    body = _tsv_body(_rows(n=200))
+    srv_cm = serve(model, serve_batch_timeout_ms=200)
+    srv = srv_cm.__enter__()
+    try:
+        got = []
+        t = threading.Thread(target=lambda: got.append(
+            post(srv.url, "/predict", body)))
+        t.start()
+        # wait until the request is genuinely in flight (inside the
+        # 200ms batching window) before starting the drain
+        import time
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if srv.state.metrics.in_flight >= 1:
+                break
+            time.sleep(0.005)
+        assert srv.state.metrics.in_flight >= 1
+    finally:
+        srv_cm.__exit__(None, None, None)   # graceful drain
+    t.join(15)
+    assert got and got[0][0] == 200
+    assert len(got[0][1].splitlines()) == 200
+
+
+# ---------------------------------------------------------------------------
+# forest unit behavior
+# ---------------------------------------------------------------------------
+
+def test_bucket_rows_powers_of_two():
+    assert [bucket_rows(n) for n in (1, 16, 17, 64, 65, 1000)] == \
+        [16, 16, 32, 64, 128, 1024]
+
+
+def test_forest_engines_agree_bitwise():
+    jf = ServingForest(BINARY_MODEL, backend="jax")
+    hf = ServingForest(BINARY_MODEL, backend="native")
+    assert (jf.engine, hf.engine) == ("jax", "host")
+    rng = np.random.RandomState(3)
+    x = rng.randn(257, 4)
+    for mode in ("normal", "raw", "leaf"):
+        a, b = jf.predict(x, mode), hf.predict(x, mode)
+        np.testing.assert_array_equal(a, b)
+        assert jf.format_rows(a, mode) == hf.format_rows(b, mode)
+
+
+@pytest.mark.skipif(native.get_lib() is None,
+                    reason="native library unavailable")
+def test_forest_native_text_path_matches_numeric(tmp_path):
+    """The host engine's fused predict_chunk pass and the numeric
+    descent produce identical bytes for the same text."""
+    hf = ServingForest(BINARY_MODEL, backend="native")
+    rows = _rows(n=64)
+    text = _tsv_body(rows)
+    for mode in ("normal", "raw", "leaf"):
+        got = hf.predict_text(text, "tsv", "\t", mode)
+        assert got is not None
+        blob, n = got
+        assert n == 64
+        lines = [ln for ln in text.decode().splitlines() if ln.strip("\r")]
+        from lightgbm_tpu.io.parser import parse_predict_rows
+        feats, _ = parse_predict_rows(lines, hf.label_idx,
+                                      hf.max_feature_idx + 1)
+        res = hf.predict(feats, mode)
+        assert hf.format_rows(res, mode) == blob, mode
+
+
+def test_num_model_predict_truncates_forest():
+    f = ServingForest(BINARY_MODEL, num_model_predict=1)
+    assert f.num_models == 1
+    full = ServingForest(BINARY_MODEL)
+    assert full.num_models == 3
+
+
+# ---------------------------------------------------------------------------
+# stress (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multi_client_stress_mixed_modes_and_reloads(tmp_path):
+    """32 closed-loop clients across all modes + periodic hot swaps:
+    every response must byte-match a single-model batch answer."""
+    model_a = _write(tmp_path / "a.txt", BINARY_MODEL)
+    model_b = _write(tmp_path / "b.txt", BINARY_MODEL.replace(
+        "leaf_value=0.2 -0.13 0.34", "leaf_value=0.55 -0.44 0.33"))
+    modes = ["normal", "raw", "leaf"]
+    wants = {}
+    bodies = {}
+    for i in range(8):
+        rows = _rows(n=5 + 3 * i, seed=500 + i)
+        data = _write(tmp_path / ("s%d.tsv" % i), _tsv_body(rows).decode())
+        bodies[i] = _tsv_body(rows)
+        for m in modes:
+            for tag, mp in (("a", model_a), ("b", model_b)):
+                wants[(i, m, tag)] = cli_predict(tmp_path, mp, data, m)
+    with serve(model_a, serve_batch_timeout_ms=2,
+               serve_max_batch_rows=128) as srv:
+        errs, checked = [], [0]
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def client(ci):
+            k = 0
+            while not stop.is_set():
+                i = (ci + k) % 8
+                m = modes[(ci + k) % 3]
+                k += 1
+                try:
+                    _, out = post(srv.url, "/predict?mode=" + m, bodies[i])
+                except Exception as ex:
+                    errs.append(ex)
+                    return
+                if out not in (wants[(i, m, "a")], wants[(i, m, "b")]):
+                    errs.append(AssertionError((ci, i, m)))
+                    return
+                with lock:
+                    checked[0] += 1
+
+        ts = [threading.Thread(target=client, args=(ci,))
+              for ci in range(32)]
+        for t in ts:
+            t.start()
+        import time
+        for target in (model_b, model_a, model_b, model_a):
+            time.sleep(0.4)
+            post(srv.url, "/reload",
+                 json.dumps({"model": target}).encode(), "application/json")
+        time.sleep(0.4)
+        stop.set()
+        for t in ts:
+            t.join(30)
+    assert not errs, errs[:3]
+    assert checked[0] > 100

@@ -1,0 +1,184 @@
+"""MicroBatcher correctness: coalescing, per-request result scatter
+(no cross-request bleed), oversize-request split/reassembly, 0-row
+requests, per-item error isolation, graceful drain.
+
+These drive the batcher directly (no HTTP) so coalescing is
+deterministic: a long window + a barrier guarantees concurrent submits
+land in ONE dispatch.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.serving.batcher import (MicroBatcher, RowsPayload,
+                                          TextPayload, count_rows)
+
+
+def _echo_runner(record=None):
+    """run_batch that 'predicts' each row as itself (identity), so any
+    cross-request mixup is visible in the results."""
+    def run(key, payloads):
+        if record is not None:
+            record.append((key, [p.nrows for p in payloads]))
+        return [p.feats.copy() for p in payloads]
+    return run
+
+
+def test_concurrent_requests_get_their_own_rows_back():
+    record = []
+    b = MicroBatcher(_echo_runner(record), max_batch_rows=1024,
+                     batch_timeout_ms=150)
+    n_clients = 16
+    start = threading.Barrier(n_clients)
+    results = [None] * n_clients
+    errors = []
+
+    def client(i):
+        feats = np.full((3 + i, 4), float(i))
+        try:
+            start.wait()
+            parts = b.submit(("m", "normal"), RowsPayload(feats))
+            results[i] = np.concatenate(parts, axis=0)
+        except Exception as ex:  # pragma: no cover - fails the assert below
+            errors.append(ex)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for i in range(n_clients):
+        assert results[i].shape == (3 + i, 4)
+        assert (results[i] == float(i)).all(), "request %d got foreign rows" % i
+    # the barrier + 150ms window must have coalesced: fewer dispatches
+    # than clients, and at least one multi-request batch
+    assert len(record) < n_clients
+    assert max(len(sizes) for _, sizes in record) > 1
+    b.shutdown()
+
+
+def test_oversize_request_splits_and_reassembles_in_order():
+    record = []
+    b = MicroBatcher(_echo_runner(record), max_batch_rows=8,
+                     batch_timeout_ms=0)
+    feats = np.arange(27 * 2, dtype=np.float64).reshape(27, 2)
+    parts = b.submit(("m", "normal"), RowsPayload(feats))
+    assert [p.shape[0] for p in parts] == [8, 8, 8, 3]
+    np.testing.assert_array_equal(np.concatenate(parts, axis=0), feats)
+    # no dispatch ever exceeded max_batch_rows
+    assert all(sum(sizes) <= 8 for _, sizes in record)
+    b.shutdown()
+
+
+def test_zero_row_request_returns_empty_result():
+    b = MicroBatcher(_echo_runner(), max_batch_rows=16, batch_timeout_ms=0)
+    parts = b.submit(("m", "normal"), RowsPayload(np.zeros((0, 5))))
+    assert len(parts) == 1 and parts[0].shape == (0, 5)
+    b.shutdown()
+
+
+def test_keys_do_not_mix():
+    """Items of different keys (mode / forest epoch) never share a
+    dispatch even inside one batching window."""
+    record = []
+    b = MicroBatcher(_echo_runner(record), max_batch_rows=64,
+                     batch_timeout_ms=100)
+    outs = {}
+
+    def client(key, val):
+        outs[val] = b.submit(key, RowsPayload(np.full((4, 2), val)))[0]
+
+    threads = [threading.Thread(target=client, args=(("m", k), float(i)))
+               for i, k in enumerate(["normal", "raw", "normal", "leaf"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for val, out in outs.items():
+        assert (out == val).all()
+    for key, _ in record:
+        assert key[1] in ("normal", "raw", "leaf")
+    b.shutdown()
+
+
+def test_per_item_errors_do_not_poison_neighbors():
+    def run(key, payloads):
+        out = []
+        for p in payloads:
+            if (p.feats < 0).any():
+                out.append(ValueError("bad rows"))
+            else:
+                out.append(p.feats)
+        return out
+
+    b = MicroBatcher(run, max_batch_rows=64, batch_timeout_ms=50)
+    res = {}
+
+    def client(i, val):
+        try:
+            res[i] = b.submit(("m",), RowsPayload(np.full((2, 2), val)))
+        except ValueError as ex:
+            res[i] = ex
+
+    threads = [threading.Thread(target=client, args=(i, v))
+               for i, v in enumerate([1.0, -1.0, 2.0])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert isinstance(res[1], ValueError)
+    assert (res[0][0] == 1.0).all() and (res[2][0] == 2.0).all()
+    b.shutdown()
+
+
+def test_batch_error_propagates_to_all_items_of_that_batch_only():
+    calls = []
+
+    def run(key, payloads):
+        calls.append(len(payloads))
+        if key == "boom":
+            raise RuntimeError("kernel died")
+        return [p.feats for p in payloads]
+
+    b = MicroBatcher(run, max_batch_rows=64, batch_timeout_ms=0)
+    with pytest.raises(RuntimeError):
+        b.submit("boom", RowsPayload(np.zeros((2, 2))))
+    out = b.submit("ok", RowsPayload(np.ones((2, 2))))
+    assert (out[0] == 1.0).all()
+    b.shutdown()
+
+
+def test_text_payload_split_counts_rows_on_line_boundaries():
+    text = b"1\t2\n\n3\t4\n5\t6\r\n\n7\t8\n"
+    p = TextPayload(text, "tsv", "\t")
+    assert p.nrows == count_rows(text) == 4
+    head, tail = p.split(3)
+    assert head.nrows == 3 and tail.nrows == 1
+    assert head.text + tail.text == text
+    assert count_rows(head.text) == 3 and count_rows(tail.text) == 1
+
+
+def test_shutdown_drains_queued_work():
+    slow_started = threading.Event()
+
+    def run(key, payloads):
+        slow_started.set()
+        time.sleep(0.05)
+        return [p.feats for p in payloads]
+
+    b = MicroBatcher(run, max_batch_rows=4, batch_timeout_ms=0)
+    got = []
+    t = threading.Thread(target=lambda: got.append(
+        b.submit("k", RowsPayload(np.ones((9, 1))))))
+    t.start()
+    slow_started.wait(5)
+    b.shutdown()
+    t.join(10)
+    assert got and sum(p.shape[0] for p in got[0]) == 9
+    with pytest.raises(RuntimeError):
+        b.submit("k", RowsPayload(np.ones((1, 1))))
