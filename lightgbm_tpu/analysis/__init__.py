@@ -1,0 +1,47 @@
+"""Static analysis + runtime guards for the project's hot-path invariants.
+
+The codebase carries several load-bearing invariants that no ordinary
+test exercises directly — they hold by construction until someone edits
+the wrong line, and then they regress silently:
+
+  * the native `task=predict` fast path and the CLI arg-parse never
+    import jax (predict_fast.py docstring; BASELINE.md measured the
+    JAX startup tax at over half the 1M-row predict wall);
+  * device code never host-syncs mid-trace and never touches float64
+    (x64 is off during training; bit-parity with the reference is the
+    whole point, PARITY.md);
+  * the serving forest never recompiles in steady state (the
+    power-of-two pre-compile contract, serving/forest.py);
+  * serving shared state mutates only under its lock.
+
+This package machine-checks them:
+
+  graftlint.py  AST linter (`python -m lightgbm_tpu.analysis`), ~10
+                project-specific rules with verified inline
+                suppressions.  Pure stdlib — runs without jax.
+  typegate.py   annotation-completeness gate for the mypy-strict
+                modules (config.py, api.py, serving/) so the typing
+                bar holds even on machines without mypy.
+  guards.py     runtime counters: XLA compile + explicit-transfer
+                accounting as a context manager and pytest fixture,
+                so tests can assert "zero recompiles" budgets.
+
+See README.md "Static analysis & invariants" for the rule table and
+the suppression syntax.
+"""
+
+__all__ = ["run_graftlint", "run_typegate", "compile_budget",
+           "track_compiles", "GuardViolation"]
+
+
+def __getattr__(name):  # PEP 562: keep `import lightgbm_tpu.analysis` light
+    if name in ("run_graftlint",):
+        from .graftlint import run_graftlint
+        return run_graftlint
+    if name in ("run_typegate",):
+        from .typegate import run_typegate
+        return run_typegate
+    if name in ("compile_budget", "track_compiles", "GuardViolation"):
+        from . import guards
+        return getattr(guards, name)
+    raise AttributeError(name)

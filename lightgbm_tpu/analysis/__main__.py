@@ -1,0 +1,86 @@
+"""`python -m lightgbm_tpu.analysis` — run graftlint + the typing gate.
+
+Exit codes (scripts/lint.sh and CI gate on these):
+  0  clean
+  1  findings (lint violations, bad/stale suppressions, typing gaps)
+  2  usage / internal error
+
+Options:
+  --list-rules     print the rule table and exit
+  --no-typegate    graftlint only
+  --json           machine-readable findings (one object per line)
+  [paths...]       specific files (default: the whole package)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from .graftlint import RULES, Finding, run_graftlint
+from .typegate import gated_modules, run_typegate
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = False
+    typegate = True
+    paths: List[str] = []
+    for arg in argv:
+        if arg == "--list-rules":
+            for rid, name in sorted(RULES.items()):
+                print("%s  %s" % (rid, name))
+            print("TYPE   annotation-completeness on: %s"
+                  % ", ".join(gated_modules()))
+            return 0
+        if arg == "--json":
+            as_json = True
+        elif arg == "--no-typegate":
+            typegate = False
+        elif arg.startswith("-"):
+            print("unknown option %s" % arg, file=sys.stderr)
+            return 2
+        else:
+            paths.append(arg)
+
+    try:
+        findings: List[Finding] = run_graftlint(paths or None)
+        if typegate:
+            if paths:
+                # explicit paths scope the run but must not silently
+                # waive the typing bar for gated modules among them
+                import os
+
+                from .graftlint import package_root
+                root = package_root()
+                gated = [p for p in paths
+                         if os.path.relpath(
+                             os.path.abspath(p), root).replace(
+                                 os.sep, "/") in gated_modules(root)]
+                if gated:
+                    findings += run_typegate(gated)
+            else:
+                findings += run_typegate()
+    except Exception as ex:  # internal error must not read as "clean"
+        print("graftlint internal error: %s" % ex, file=sys.stderr)
+        return 2
+
+    if as_json:
+        for f in findings:
+            print(json.dumps(f.__dict__))
+    else:
+        for f in findings:
+            print(f.render())
+        n_lint = sum(1 for f in findings if f.rule in RULES)
+        n_type = len(findings) - n_lint
+        if findings:
+            print("graftlint: %d finding(s) (%d lint, %d typing)"
+                  % (len(findings), n_lint, n_type))
+        else:
+            print("graftlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

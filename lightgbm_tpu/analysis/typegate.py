@@ -1,0 +1,116 @@
+"""typegate — annotation-completeness gate for the mypy-strict modules.
+
+pyproject.toml runs mypy --strict over config.py, api.py and serving/;
+this container-independent gate enforces the part of that bar that an
+AST can check (every function fully annotated: parameters AND return),
+so the typing floor holds even on machines without mypy installed.
+`scripts/lint.sh` runs real mypy too whenever it is available.
+
+Rules mirror mypy's disallow_untyped_defs / disallow_incomplete_defs:
+  * every parameter except self/cls needs an annotation (including
+    *args / **kwargs);
+  * every function needs a return annotation, except __init__ /
+    __init_subclass__ (mypy infers -> None there when the params are
+    annotated);
+  * nested functions count (mypy strict checks them).
+Lambdas are exempt, as in mypy.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from .graftlint import Finding, _attach_parents, package_root
+
+# package-relative modules held to the strict-typing bar (keep in sync
+# with [tool.mypy] in pyproject.toml).  serving/ is globbed at run time
+# so a new serving module cannot silently escape the gate.
+GATED_MODULES = (
+    "config.py",
+    "api.py",
+    "analysis/guards.py",
+)
+GATED_DIRS = ("serving",)
+
+
+def gated_modules(root: Optional[str] = None) -> List[str]:
+    """Every package-relative module the typing gate covers, with the
+    gated directories expanded to their current contents."""
+    root = root or package_root()
+    out = list(GATED_MODULES)
+    for d in GATED_DIRS:
+        full = os.path.join(root, d)
+        if os.path.isdir(full):
+            out.extend(sorted(
+                "%s/%s" % (d, fn) for fn in os.listdir(full)
+                if fn.endswith(".py")))
+    return out
+
+RETURN_EXEMPT = {"__init__", "__init_subclass__"}
+
+
+def _check_module(tree: ast.AST, display: str) -> List[Finding]:
+    out: List[Finding] = []
+    _attach_parents(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        in_class = isinstance(getattr(node, "_gl_parent", None),
+                              ast.ClassDef)
+        args = node.args
+        params = (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs))
+        missing = []
+        for i, p in enumerate(params):
+            if in_class and i == 0 and p.arg in ("self", "cls"):
+                continue
+            if p.annotation is None:
+                missing.append(p.arg)
+        for star in (args.vararg, args.kwarg):
+            if star is not None and star.annotation is None:
+                missing.append("*" + star.arg)
+        if missing:
+            out.append(Finding(
+                display, node.lineno, "TYPE",
+                "def %s: unannotated parameter(s) %s"
+                % (node.name, ", ".join(missing))))
+        n_annotated = sum(1 for p in params if p.annotation is not None)
+        # mypy only infers -> None for __init__ when at least one
+        # parameter is annotated; a zero-argument __init__ still needs
+        # the explicit -> None under strict
+        exempt = node.name in RETURN_EXEMPT and n_annotated > 0
+        if node.returns is None and not exempt:
+            out.append(Finding(
+                display, node.lineno, "TYPE",
+                "def %s: missing return annotation" % node.name))
+    return out
+
+
+def run_typegate(paths: Optional[Sequence[str]] = None,
+                 root: Optional[str] = None) -> List[Finding]:
+    root = root or package_root()
+    if paths is None:
+        paths = [os.path.join(root, rel.replace("/", os.sep))
+                 for rel in gated_modules(root)]
+    out: List[Finding] = []
+    for path in paths:
+        display = (os.path.relpath(path, os.getcwd())
+                   if os.path.isabs(path) else path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=display)
+        except (OSError, SyntaxError) as ex:
+            out.append(Finding(display, 1, "TYPE",
+                               "unreadable/unparseable: %s" % ex))
+            continue
+        out.extend(_check_module(tree, display))
+    out.sort(key=lambda f: (f.path, f.line))
+    return out
+
+
+def check_source(source: str, display: str = "<string>") -> List[Finding]:
+    """Gate one in-memory module (test helper)."""
+    return _check_module(ast.parse(source), display)

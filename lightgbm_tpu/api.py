@@ -33,7 +33,7 @@ src/application/application.cpp:218-236, incl. early stopping).
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -54,7 +54,7 @@ def _to_config(params: Optional[Dict]) -> Config:
     return Config.from_params(apply_aliases(p))
 
 
-def _is_sparse(data) -> bool:
+def _is_sparse(data: Any) -> bool:
     try:
         import scipy.sparse as sp
         return sp.issparse(data)
@@ -62,7 +62,7 @@ def _is_sparse(data) -> bool:
         return False
 
 
-def _as_dense(data) -> np.ndarray:
+def _as_dense(data: Any) -> np.ndarray:
     """Accept ndarray / scipy CSR / CSC (the reference's 4 matrix adapters,
     c_api.cpp:589-770); densify sparse — only used where a dense matrix is
     genuinely needed (prediction); INGEST of sparse input is O(nnz)
@@ -87,10 +87,11 @@ class Dataset:
     src/io/metadata.cpp:252-327) or per-row query ids.
     """
 
-    def __init__(self, data: ArrayLike, label=None,
+    def __init__(self, data: ArrayLike, label: Any = None,
                  params: Optional[Dict] = None,
                  reference: Optional["Dataset"] = None,
-                 weight=None, group=None, init_score=None,
+                 weight: Any = None, group: Any = None,
+                 init_score: Any = None,
                  feature_names: Optional[Sequence[str]] = None,
                  free_raw_data: bool = True):
         self.params = dict(params or {})
@@ -166,7 +167,7 @@ class Dataset:
         self._finish_inner(bins, bin_mappers, used_feature_map,
                            real_index, ncols, names, label)
 
-    def _construct_from_sparse(self, sp_mat) -> None:
+    def _construct_from_sparse(self, sp_mat: Any) -> None:
         """CSR/CSC input binned in O(nnz + F*N) memory without ever
         materializing the dense float matrix (VERDICT r3 missing #1; the
         reference builds Datasets straight from its sparse adapters,
@@ -183,7 +184,10 @@ class Dataset:
         csc = sp_mat.tocsc()
         cfg = self.config
 
-        def col_bins(mapper, real, dtype, out_n, indptr, indices, data):
+        def col_bins(mapper: BinMapper, real: int, dtype: type,
+                     out_n: int, indptr: np.ndarray,
+                     indices: np.ndarray,
+                     data: np.ndarray) -> np.ndarray:
             zb = mapper.value_to_bin(np.zeros(1))[0]
             row = np.full(out_n, zb, dtype=dtype)
             if real >= len(indptr) - 1:
@@ -242,7 +246,9 @@ class Dataset:
         self._finish_inner(bins, bin_mappers, used_feature_map,
                            real_index, ncols, names, label)
 
-    def _filter_mappers(self, mappers_all, ncols):
+    def _filter_mappers(
+            self, mappers_all: List[Optional[BinMapper]], ncols: int
+    ) -> Tuple[np.ndarray, List[BinMapper], List[int], List[str], type]:
         """Drop trivial (single-value) features, like the reference's
         used-feature map construction (dataset_loader.cpp:600-640)."""
         used_feature_map = np.full(ncols, -1, dtype=np.int32)
@@ -264,8 +270,11 @@ class Dataset:
         dtype = np.uint8 if max_bin_used <= 256 else np.uint16
         return used_feature_map, bin_mappers, real_index, names, dtype
 
-    def _finish_inner(self, bins, bin_mappers, used_feature_map,
-                      real_index, ncols, names, label) -> None:
+    def _finish_inner(self, bins: np.ndarray,
+                      bin_mappers: Sequence[BinMapper],
+                      used_feature_map: np.ndarray,
+                      real_index: Sequence[int], ncols: int,
+                      names: Sequence[str], label: np.ndarray) -> None:
         self._inner = io_dataset.Dataset(
             bins=bins, bin_mappers=list(bin_mappers),
             used_feature_map=np.asarray(used_feature_map, dtype=np.int32),
@@ -289,7 +298,7 @@ class Dataset:
     def inner(self) -> io_dataset.Dataset:
         return self._inner
 
-    def set_field(self, name: str, data) -> None:
+    def set_field(self, name: str, data: Any) -> None:
         md = self._inner.metadata
         if name == "label":
             md.label = np.asarray(data, dtype=np.float32).reshape(-1)
@@ -323,7 +332,7 @@ class Dataset:
         else:
             log.fatal("Unknown dataset field %s" % name)
 
-    def get_field(self, name: str):
+    def get_field(self, name: str) -> Optional[np.ndarray]:
         md = self._inner.metadata
         if name == "label":
             return md.label
@@ -335,16 +344,16 @@ class Dataset:
             return md.query_boundaries
         log.fatal("Unknown dataset field %s" % name)
 
-    def set_label(self, label) -> None:
+    def set_label(self, label: Any) -> None:
         self.set_field("label", label)
 
-    def set_weight(self, weight) -> None:
+    def set_weight(self, weight: Any) -> None:
         self.set_field("weight", weight)
 
-    def set_group(self, group) -> None:
+    def set_group(self, group: Any) -> None:
         self.set_field("group", group)
 
-    def set_init_score(self, init_score) -> None:
+    def set_init_score(self, init_score: Any) -> None:
         self.set_field("init_score", init_score)
 
     def get_label(self) -> np.ndarray:
@@ -481,7 +490,7 @@ class Booster:
         return out
 
     # -- prediction (LGBM_BoosterPredictForMat etc.) --------------------
-    def predict(self, data, raw_score: bool = False,
+    def predict(self, data: Any, raw_score: bool = False,
                 pred_leaf: bool = False,
                 num_iteration: int = -1) -> np.ndarray:
         if _is_sparse(data):
@@ -509,7 +518,8 @@ class Booster:
     # chunk, so peak is a small multiple of this)
     _SPARSE_PREDICT_BUDGET = 1 << 22
 
-    def _predict_sparse(self, data, raw_score: bool, pred_leaf: bool,
+    def _predict_sparse(self, data: Any, raw_score: bool,
+                        pred_leaf: bool,
                         num_iteration: int) -> np.ndarray:
         """O(nnz) CSR/CSC prediction (VERDICT r4 #4; reference
         LGBM_BoosterPredictForCSR/CSC, c_api.cpp:529-556 with the row

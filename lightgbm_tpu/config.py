@@ -16,7 +16,7 @@ Config; they control the JAX mesh instead of the socket/MPI bootstrap.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .utils import log
 
@@ -211,19 +211,19 @@ class Config:
         c = Config()
         getp = params.get
 
-        def set_int(key, attr=None):
+        def set_int(key: str, attr: Optional[str] = None) -> None:
             if key in params:
                 setattr(c, attr or key, int(params[key]))
 
-        def set_float(key, attr=None):
+        def set_float(key: str, attr: Optional[str] = None) -> None:
             if key in params:
                 setattr(c, attr or key, float(params[key]))
 
-        def set_bool(key, attr=None):
+        def set_bool(key: str, attr: Optional[str] = None) -> None:
             if key in params:
                 setattr(c, attr or key, _parse_bool(params[key]))
 
-        def set_str(key, attr=None):
+        def set_str(key: str, attr: Optional[str] = None) -> None:
             if key in params:
                 setattr(c, attr or key, params[key].strip())
 
@@ -434,7 +434,7 @@ def apply_aliases(params: Dict[str, str]) -> Dict[str, str]:
     return out
 
 
-def parse_kv_line(line: str) -> Optional[tuple]:
+def parse_kv_line(line: str) -> Optional[Tuple[str, str]]:
     line = line.split("#", 1)[0].strip()
     if not line:
         return None

@@ -36,6 +36,35 @@ def detect_format(sample_lines: List[str]) -> str:
     return "tsv"
 
 
+def sniff_format(read_block, has_header: bool = False) -> Tuple[str, str]:
+    """(fmt, sep) from the first data lines of a byte stream — the ONE
+    home of the complete-lines sniff rule shared by the predict fast
+    path (predict_fast._sniff_format) and the serving request sniff
+    (serving/server._sniff_sep), so the two cannot drift.
+
+    read_block() -> bytes yields successive chunks, b"" at EOF.  Only
+    COMPLETE (newline-terminated) non-blank lines feed detect_format
+    unless EOF ended the last one — a single fixed-size read once
+    misdetected the format when the first line exceeded the read,
+    because the partial line was sniffed as if it were whole."""
+    need = 2 + (1 if has_header else 0)
+    buf = b""
+    while True:
+        block = read_block()
+        buf += block
+        eof = not block
+        cut = len(buf) if eof else buf.rfind(b"\n") + 1
+        lines = [ln for ln in
+                 buf[:cut].decode("utf-8", "replace").splitlines()
+                 if ln.strip("\r")]
+        if eof or len(lines) >= need:
+            break
+    if has_header and lines:
+        lines = lines[1:]
+    fmt = detect_format(lines[:2])
+    return fmt, ("," if fmt == "csv" else "\t")
+
+
 _PLAIN_DECIMAL = re.compile(r"^[+-]?[0-9]+(\.[0-9]*)?([eE][+-]?[0-9]+)?$"
                             r"|^[+-]?\.[0-9]+([eE][+-]?[0-9]+)?$")
 

@@ -14,7 +14,6 @@ parity tests with bagging enabled.
 
 from __future__ import annotations
 
-import time
 from collections import OrderedDict
 from typing import List, Optional, Sequence
 
@@ -28,7 +27,7 @@ from ..metrics import Metric
 from ..objectives import Objective
 from ..ops.grow import grow_tree
 from ..ops.predict import predict_leaf_binned
-from ..ops.split import SplitParams, K_MIN_SCORE
+from ..ops.split import SplitParams
 from ..utils import log
 from ..utils.mt19937 import Mt19937Random
 from .tree import Tree

@@ -16,7 +16,7 @@ per-query pairwise O(L^2) work is tiny relative to tree growth).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 from .utils.compile_cache import enable_compilation_cache
 
@@ -296,6 +296,9 @@ class MulticlassSoftmax(Objective):
             stays f32."""
             onehot, weights = state
             score = score.astype(jnp.float32)
+            # graftlint: disable=GL003 -- reference parity REQUIRES the
+            # f64 softmax (double rec[] in common.h:353-367); with x64
+            # off the astype is a no-op and the math stays f32
             p = jax.nn.softmax(score.astype(jnp.float64), axis=0) \
                 .astype(jnp.float32)
             grad = p - onehot

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from .histogram import leaf_histogram, make_gvals
 from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
-                    leaf_output, per_feature_best)
+                    per_feature_best)
 
 
 class TreeArrays(NamedTuple):

@@ -250,6 +250,9 @@ def accumulate_scores(leaves: jax.Array, leaf_values: jax.Array,
     """
     c = leaves.shape[0]
     t = leaf_values.shape[0]
+    # graftlint: disable=GL003 -- f64 IS the contract here: this kernel
+    # replicates the host's double score accumulation bit-for-bit and
+    # only runs when the CLI predict path enabled x64 (cli.init_predict)
     out = jnp.zeros((num_class, c), dtype=jnp.float64)
 
     def step(s, inp):

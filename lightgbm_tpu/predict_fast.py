@@ -30,7 +30,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .config import Config
-from .io.parser import detect_format
+from .io.parser import sniff_format
 from .models.tree import Tree, parse_model_text
 from .utils import log
 
@@ -144,31 +144,11 @@ SNIFF_BYTES = 1 << 20
 
 
 def _sniff_format(path: str, has_header: bool) -> Tuple[str, str]:
-    """(fmt, sep) from the first data lines (Parser::CreateParser role).
-
-    Reads until it holds (header +) two COMPLETE non-blank lines — a
-    single fixed-size read once misdetected the format when the header
-    line was longer than the read, because the partial header was
-    dropped as if it were the whole header and whatever followed (or
-    nothing) was sniffed instead."""
-    need = 2 + (1 if has_header else 0)
-    buf = b""
+    """(fmt, sep) from the first data lines (Parser::CreateParser role),
+    via the shared complete-lines sniff (io/parser.sniff_format — also
+    the serving request sniff, so the two paths cannot drift)."""
     with open(path, "rb") as f:
-        while True:
-            block = f.read(SNIFF_BYTES)
-            buf += block
-            eof = not block
-            # only complete lines count unless EOF ended the last one
-            cut = len(buf) if eof else buf.rfind(b"\n") + 1
-            lines = [ln for ln in
-                     buf[:cut].decode("utf-8", "replace").splitlines()
-                     if ln.strip("\r")]
-            if eof or len(lines) >= need:
-                break
-    if has_header and lines:
-        lines = lines[1:]
-    fmt = detect_format(lines[:2])
-    return fmt, ("," if fmt == "csv" else "\t")
+        return sniff_format(lambda: f.read(SNIFF_BYTES), has_header)
 
 
 def try_fast_predict(cfg: Config) -> bool:

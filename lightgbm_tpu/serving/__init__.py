@@ -28,7 +28,7 @@ if TYPE_CHECKING:  # pragma: no cover - annotation-only re-exports
 __all__ = ["ServingForest", "MicroBatcher"]
 
 
-def __getattr__(name):  # PEP 562 lazy exports, like the package root
+def __getattr__(name: str) -> object:  # PEP 562 lazy exports, like the package root
     if name == "ServingForest":
         from .forest import ServingForest
         return ServingForest

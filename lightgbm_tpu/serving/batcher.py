@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -38,7 +38,7 @@ class RowsPayload:
     def nrows(self) -> int:
         return self.feats.shape[0]
 
-    def split(self, k: int):
+    def split(self, k: int) -> Tuple["RowsPayload", "RowsPayload"]:
         return RowsPayload(self.feats[:k]), RowsPayload(self.feats[k:])
 
 
@@ -56,11 +56,14 @@ class TextPayload:
         self.sep = sep
         self.nrows = (count_rows(text) if nrows is None else nrows)
 
-    def split(self, k: int):
+    def split(self, k: int) -> Tuple["TextPayload", "TextPayload"]:
         cut = _row_offset(self.text, k)
         return (TextPayload(self.text[:cut], self.fmt, self.sep, k),
                 TextPayload(self.text[cut:], self.fmt, self.sep,
                             self.nrows - k))
+
+
+Payload = Union[RowsPayload, TextPayload]
 
 
 def count_rows(text: bytes) -> int:
@@ -91,11 +94,11 @@ class BatcherClosed(RuntimeError):
 class _Item:
     __slots__ = ("key", "payload", "done", "result", "error", "enq_t")
 
-    def __init__(self, key, payload):
+    def __init__(self, key: Any, payload: "Payload"):
         self.key = key
         self.payload = payload
         self.done = threading.Event()
-        self.result = None
+        self.result: Any = None
         self.error: Optional[BaseException] = None
         self.enq_t = time.monotonic()
 
@@ -122,11 +125,11 @@ class MicroBatcher:
         self._worker.start()
 
     # -- client side -----------------------------------------------------
-    def submit(self, key, payload) -> List:
+    def submit(self, key: Any, payload: "Payload") -> List[Any]:
         """Enqueue one request (split into <= max_batch_rows segments),
         block until every segment completes, return the per-segment
         results in order."""
-        segments = []
+        segments: List[Payload] = []
         while payload.nrows > self.max_batch_rows:
             head, payload = payload.split(self.max_batch_rows)
             segments.append(head)
@@ -165,10 +168,15 @@ class MicroBatcher:
                 else:
                     rest.append(it)
             if rows >= self.max_batch_rows or self._stopped:
+                # graftlint: disable=GL006 -- _take_batch's contract is
+                # "called with self._cv held" (the _loop call site); the
+                # lock cannot appear lexically here
                 self._queue = rest
                 return batch
             wait = deadline - time.monotonic()
             if wait <= 0:
+                # graftlint: disable=GL006 -- same _cv-held contract as
+                # the dispatch-full branch above (see _loop's with block)
                 self._queue = rest
                 return batch
             self._cv.wait(wait)

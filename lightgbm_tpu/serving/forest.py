@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -80,10 +80,10 @@ class ServingForest:
 
         self._engine = self._pick_engine(backend)
         self._lock = threading.Lock()   # guards lazy pack builds only
-        self._jax_pack = None
-        self._native_spec = None
+        self._jax_pack: Optional[Dict[str, Any]] = None
+        self._native_spec: Optional[Any] = None
         self._native_spec_tried = False
-        self._host_pack = None
+        self._host_pack: Optional[Dict[str, Any]] = None
         if self._engine == "jax":
             self._build_jax_pack()
 
@@ -106,7 +106,8 @@ class ServingForest:
         return self._engine
 
     # -- packed representations ----------------------------------------
-    def _flat_arrays(self):
+    def _flat_arrays(self) -> Tuple[np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray, np.ndarray]:
         """[T, M] padded node arrays + [T, L] leaf values (the
         GBDT._stacked_trees layout, rebuilt here without a jax import)."""
         trees = self.trees
@@ -129,7 +130,7 @@ class ServingForest:
             lv[i, :tr.num_leaves] = tr.leaf_value[:tr.num_leaves]
         return sf, thr, lc, rc, lv
 
-    def _build_jax_pack(self):
+    def _build_jax_pack(self) -> Dict[str, Any]:
         if self._jax_pack is not None:
             return self._jax_pack
         with self._lock:
@@ -143,7 +144,7 @@ class ServingForest:
                 self._jax_pack = {"dev": dev, "lv": lv}
         return self._jax_pack
 
-    def _build_host_pack(self):
+    def _build_host_pack(self) -> Dict[str, Any]:
         if self._host_pack is not None:
             return self._host_pack
         with self._lock:
@@ -152,7 +153,7 @@ class ServingForest:
                 self._host_pack = {"lv": lv}
         return self._host_pack
 
-    def _native_forest(self):
+    def _native_forest(self) -> Optional[Any]:
         """native.ForestSpec for the fused text kernel, or None."""
         if not self._native_spec_tried:
             with self._lock:
@@ -281,7 +282,7 @@ class ServingForest:
         return n_buckets
 
     # -- introspection ---------------------------------------------------
-    def info(self) -> dict:
+    def info(self) -> Dict[str, Any]:
         return {
             "source": self.source,
             "engine": self._engine,

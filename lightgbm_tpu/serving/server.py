@@ -32,13 +32,13 @@ import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import ParseResult, parse_qs, urlparse
 
 import numpy as np
 
 from ..config import Config
-from ..io.parser import detect_format, parse_predict_rows
+from ..io.parser import parse_predict_rows, sniff_format
 from ..utils import log
 from .batcher import BatcherClosed, MicroBatcher, RowsPayload, TextPayload
 from .forest import MODES, ServingForest, load_forest
@@ -57,17 +57,25 @@ _BATCH_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
 
 
 class _Histogram:
-    def __init__(self, buckets):
+    def __init__(self, buckets: Sequence[float]):
         self.buckets = tuple(buckets)
         self.counts = [0] * (len(self.buckets) + 1)   # +inf tail
         self.sum = 0.0
 
     def observe(self, v: float) -> None:
+        # graftlint: disable=GL006 -- _Histogram is an internal of
+        # Metrics: every observe()/render() call site holds
+        # Metrics._lock (the threaded test_serving_metrics_locking
+        # regression hammers this)
         self.sum += v
         for i, b in enumerate(self.buckets):
             if v <= b:
+                # graftlint: disable=GL006 -- same Metrics._lock-held
+                # contract as the sum update above
                 self.counts[i] += 1
                 return
+        # graftlint: disable=GL006 -- same Metrics._lock-held contract
+        # as the sum update above
         self.counts[-1] += 1
 
     def render(self, name: str, help_: str, out: List[str]) -> None:
@@ -79,17 +87,17 @@ class _Histogram:
             out.append('%s_bucket{le="%g"} %d' % (name, b, cum))
         cum += self.counts[-1]
         out.append('%s_bucket{le="+Inf"} %d' % (name, cum))
-        out.append("%s_sum %g" % (name, self.sum))
+        out.append("%s_sum %.17g" % (name, self.sum))
         out.append("%s_count %d" % (name, cum))
 
 
 class Metrics:
     """Thread-safe serving metrics, rendered in Prometheus text format."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self.started_at = time.time()
-        self.requests = {}           # (endpoint, code) -> count
+        self.requests: Dict[Tuple[str, int], int] = {}
         self.rows_total = 0
         self.batches_total = 0
         self.reloads_total = 0
@@ -153,7 +161,10 @@ class Metrics:
                        "unix time the live model was loaded")
             out.append("# TYPE lgbm_serve_model_loaded_timestamp_seconds "
                        "gauge")
-            out.append("lgbm_serve_model_loaded_timestamp_seconds %g"
+            # %.17g, not %g: a unix timestamp needs ~16 significant
+            # digits ("%g" truncates to ~hours-of-error, breaking any
+            # model-staleness alert computed from this gauge)
+            out.append("lgbm_serve_model_loaded_timestamp_seconds %.17g"
                        % forest.loaded_at)
             out.append("# HELP lgbm_serve_model_num_trees "
                        "tree count of the live model")
@@ -225,10 +236,11 @@ def _parse_text_rows(body: bytes, forest: ServingForest) -> np.ndarray:
 
 
 def _sniff_sep(body: bytes) -> Tuple[str, str]:
-    head = [ln for ln in body[:65536].decode("utf-8", "replace").splitlines()
-            if ln.strip("\r")]
-    fmt = detect_format(head[:2])
-    return fmt, ("," if fmt == "csv" else "\t")
+    """(fmt, sep) for a request body via the SHARED complete-lines
+    sniff (io/parser.sniff_format, same rule as the predict fast
+    path's file sniff — the two cannot drift)."""
+    chunks = iter((body,))
+    return sniff_format(lambda: next(chunks, b""))
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +268,7 @@ class ServingState:
     # hot-swap in-flight traffic, and the family keeps text requests of
     # different formats (csv vs tsv vs libsvm) — which cannot share one
     # native pass — out of each other's dispatches.
-    def _run_batch(self, key, payloads) -> List:
+    def _run_batch(self, key: Any, payloads: Sequence[Any]) -> List[Any]:
         forest, mode, family = key
         if family[0] == "text":
             total = sum(p.nrows for p in payloads)
@@ -301,7 +313,7 @@ class ServingState:
         return _split_lines(blob, counts)
 
     # -- hot swap -------------------------------------------------------
-    def reload(self, model_path: str) -> dict:
+    def reload(self, model_path: str) -> Dict[str, Any]:
         with self._swap_lock:
             fresh = load_forest(model_path,
                                 num_model_predict=self.cfg.num_model_predict,
@@ -320,7 +332,7 @@ class ServingState:
 def _split_lines(blob: bytes, counts: List[int]) -> List[bytes]:
     """Split newline-terminated output back per request segment (every
     predict mode emits exactly one line per row)."""
-    parts = []
+    parts: List[bytes] = []
     pos = 0
     for c in counts:
         if c == 0:
@@ -342,7 +354,7 @@ def _split_lines(blob: bytes, counts: List[int]) -> List[bytes]:
 # HTTP layer
 # ---------------------------------------------------------------------------
 
-def _make_handler(state: ServingState):
+def _make_handler(state: ServingState) -> type:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         # one buffered write per response + TCP_NODELAY: the default
@@ -352,7 +364,7 @@ def _make_handler(state: ServingState):
         wbufsize = 1 << 16
         disable_nagle_algorithm = True
 
-        def log_message(self, fmt, *args):  # route through our logger
+        def log_message(self, fmt: str, *args: Any) -> None:  # route through our logger
             log.debug("serve: " + fmt % args)
 
         def _respond(self, code: int, body: bytes,
@@ -369,18 +381,32 @@ def _make_handler(state: ServingState):
                 # we only read Content-Length bodies; an unread chunked
                 # body would desync the next keep-alive request, so
                 # refuse AND drop the connection after responding
+                # graftlint: disable=GL006 -- per-connection handler
+                # state: one thread per connection, nothing shared
                 self.close_connection = True
                 raise LengthRequired(
                     "chunked request bodies are not supported; send "
                     "Content-Length")
-            n = int(self.headers.get("Content-Length") or 0)
-            if n > MAX_BODY_BYTES:
-                self.close_connection = True   # body stays unread
-                raise BadRequest("request body too large (%d bytes)" % n)
+            raw = (self.headers.get("Content-Length") or "0").strip()
+            try:
+                n = int(raw)
+            except ValueError:
+                n = -1   # force the refusal path below
+            if n < 0 or n > MAX_BODY_BYTES:
+                # a negative length would make rfile.read() block until
+                # the client disconnects (read-to-EOF on the socket),
+                # pinning the handler thread and the in-flight gauge;
+                # garbage/absurd lengths are client faults.  Body
+                # unread either way: the connection must drop.
+                # graftlint: disable=GL006 -- per-connection handler
+                # state: one thread per connection, nothing shared
+                self.close_connection = True
+                raise BadRequest(
+                    "invalid or oversized Content-Length %r" % raw)
             return self.rfile.read(n) if n else b""
 
         # -- GET ---------------------------------------------------------
-        def do_GET(self):
+        def do_GET(self) -> None:
             t0 = time.monotonic()
             path = urlparse(self.path).path
             state.metrics.request_started(path)
@@ -406,7 +432,7 @@ def _make_handler(state: ServingState):
                                                time.monotonic() - t0)
 
         # -- POST --------------------------------------------------------
-        def do_POST(self):
+        def do_POST(self) -> None:
             t0 = time.monotonic()
             url = urlparse(self.path)
             path = url.path
@@ -434,7 +460,7 @@ def _make_handler(state: ServingState):
                                                time.monotonic() - t0,
                                                rows)
 
-        def _predict(self, url) -> Tuple[int, int]:
+        def _predict(self, url: ParseResult) -> Tuple[int, int]:
             # read the body FIRST even on early-exit paths: an unread
             # body desyncs the next request on a keep-alive connection
             body = self._body()
@@ -501,10 +527,18 @@ def _make_handler(state: ServingState):
     return Handler
 
 
-def _qbool(q, key: str, default: bool) -> bool:
+def _qbool(q: Dict[str, List[str]], key: str, default: bool) -> bool:
     if key not in q:
         return default
     return q[key][0].strip().lower() in ("1", "true", "+", "yes")
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    # the stdlib backlog of 5 overflows into client ConnectionResets
+    # when closed-loop one-connection-per-request clients pile up while
+    # a /reload warm() stalls the accept loop (the multi-client stress
+    # test reproduced it); a deeper listen queue absorbs the burst
+    request_queue_size = 128
 
 
 class ServingServer:
@@ -524,9 +558,12 @@ class ServingServer:
                  "in %.3f s" % (forest.engine, forest.num_models,
                                 n_buckets, time.time() - t0))
         self.state = ServingState(cfg, forest)
-        self.httpd = ThreadingHTTPServer((cfg.serve_host, cfg.serve_port),
-                                         _make_handler(self.state))
+        self.httpd = _HTTPServer((cfg.serve_host, cfg.serve_port),
+                                 _make_handler(self.state))
         self.httpd.daemon_threads = True
+        self._lifecycle_lock = threading.Lock()
+        self._serve_started = False
+        self._closed = False
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -538,6 +575,10 @@ class ServingServer:
         return "http://%s:%d" % (host, port)
 
     def serve_forever(self) -> None:
+        with self._lifecycle_lock:
+            if self._closed:
+                return   # shutdown() won the race: socket already closed
+            self._serve_started = True
         self.httpd.serve_forever(poll_interval=0.1)
 
     def shutdown(self, drain_timeout: float = 30.0) -> None:
@@ -545,8 +586,21 @@ class ServingServer:
         wait for the handler threads to WRITE their responses (they are
         daemon threads — exiting while one is mid-write would reset the
         client connection)."""
+        # graftlint: disable=GL006 -- single GIL-atomic bool flip with
+        # no invariant coupling: a handler that reads stale False just
+        # falls into the BatcherClosed race path and still 503s
         self.state.draining = True
-        self.httpd.shutdown()
+        with self._lifecycle_lock:
+            self._closed = True
+            started = self._serve_started
+        if started:
+            # safe even if the serve thread set the flag but has not
+            # entered the loop yet: BaseServer.serve_forever checks the
+            # shutdown request on entry and signals right back
+            self.httpd.shutdown()
+        # never started (and _closed now blocks it from starting):
+        # BaseServer.shutdown() would wait forever on the event only the
+        # serve loop sets, so skip straight to closing the socket
         self.httpd.server_close()
         self.state.batcher.shutdown()
         deadline = time.monotonic() + drain_timeout
@@ -565,11 +619,11 @@ def serve_forever(cfg: Config) -> None:
                 cfg.serve_max_batch_rows, cfg.serve_batch_timeout_ms))
     stop = threading.Event()
 
-    def _on_signal(signum, frame):
+    def _on_signal(signum: int, frame: Any) -> None:
         log.info("Signal %d: draining..." % signum)
         stop.set()
 
-    prev = {}
+    prev: Dict[int, Any] = {}
     for sig in (signal.SIGTERM, signal.SIGINT):
         prev[sig] = signal.signal(sig, _on_signal)
     t = threading.Thread(target=server.serve_forever, daemon=True)

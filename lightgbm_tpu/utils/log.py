@@ -9,6 +9,7 @@ reference CLI is possible.
 from __future__ import annotations
 
 import sys
+from typing import NoReturn
 
 DEBUG = 2
 INFO = 1
@@ -54,7 +55,10 @@ def warning(msg: str, *args) -> None:
         _write("Warning", msg % args if args else msg)
 
 
-def fatal(msg: str, *args) -> None:
+def fatal(msg: str, *args) -> NoReturn:
+    # NoReturn is load-bearing for the typing gate: callers like
+    # config._parse_bool fall through after fatal() and a plain -> None
+    # here would make their return types look Optional
     raise LightGBMError(msg % args if args else msg)
 
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# scripts/lint.sh — single static-analysis entry point for CI and humans.
+#
+#   graftlint + typegate   always (stdlib-only, python -m lightgbm_tpu.analysis)
+#   ruff                   when installed ([tool.ruff] in pyproject.toml)
+#   mypy --strict gate     when installed ([tool.mypy] in pyproject.toml)
+#
+# Tools missing from the environment are reported as SKIPPED and do not
+# fail the run (the containers bake no ruff/mypy; the stdlib gates cover
+# the invariants regardless).
+#
+# Exit codes (CI gates on these):
+#   0  everything that ran is clean
+#   1  findings (lint violations, stale/bare suppressions, typing gaps)
+#   2  internal error (a tool crashed — treat as failure, not as clean)
+
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== graftlint + typing gate (python -m lightgbm_tpu.analysis) =="
+python -m lightgbm_tpu.analysis
+g=$?
+if [ "$g" -ge 2 ]; then
+    echo "lint.sh: graftlint crashed (exit $g)" >&2
+    exit 2
+fi
+[ "$g" -ne 0 ] && rc=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check lightgbm_tpu =="
+    ruff check lightgbm_tpu
+    r=$?
+    if [ "$r" -ge 2 ]; then
+        echo "lint.sh: ruff crashed (exit $r)" >&2
+        exit 2
+    fi
+    [ "$r" -ne 0 ] && rc=1
+else
+    echo "== ruff: not installed — SKIPPED (config lives in [tool.ruff]) =="
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+    echo "== mypy --strict gate (config.py, api.py, serving/) =="
+    mypy --config-file pyproject.toml
+    m=$?
+    if [ "$m" -ge 2 ]; then
+        echo "lint.sh: mypy crashed (exit $m)" >&2
+        exit 2
+    fi
+    [ "$m" -ne 0 ] && rc=1
+else
+    echo "== mypy: not installed — SKIPPED (config lives in [tool.mypy];" \
+         "the typegate above enforces the annotation floor) =="
+fi
+
+if [ "$rc" -eq 0 ]; then
+    echo "lint.sh: clean"
+else
+    echo "lint.sh: FINDINGS (exit 1)" >&2
+fi
+exit $rc

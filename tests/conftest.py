@@ -25,5 +25,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# compile/transfer-budget fixture (lightgbm_tpu/analysis/guards.py):
+# `with xla_guard(0, what="..."):` pins recompile invariants in tests
+from lightgbm_tpu.analysis.guards import xla_guard  # noqa: E402,F401
+
 REFERENCE_DIR = "/root/reference"
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")

@@ -1,0 +1,511 @@
+"""graftlint + typegate unit tests, and the repo-is-clean gates.
+
+The rule tests lint small in-memory modules through lint_source() at a
+chosen package-relative path, so each rule's trigger and non-trigger
+are pinned independently of the real tree.  The final tests run the
+full linter over the installed package — the same check scripts/lint.sh
+gates on — so a hot-path invariant regression fails the suite even if
+nobody runs the lint script.
+"""
+
+import textwrap
+
+from lightgbm_tpu.analysis.graftlint import lint_source, run_graftlint
+from lightgbm_tpu.analysis.typegate import check_source, run_typegate
+
+
+def lint(src, relpath="ops/some_kernel.py"):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# GL001 host-sync-in-traced-fn
+# ---------------------------------------------------------------------------
+
+def test_item_in_jitted_function_flagged():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.sum().item()
+    """)
+    assert "GL001" in rules_of(out)
+
+
+def test_item_outside_trace_not_flagged():
+    out = lint("""
+        def f(x):
+            return x.sum().item()
+    """)
+    assert "GL001" not in rules_of(out)
+
+
+def test_np_asarray_in_jitted_function_flagged():
+    out = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+    """)
+    assert "GL001" in rules_of(out)
+
+
+def test_float_on_traced_value_flagged_but_shape_ok():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = float(x.shape[0])   # static: fine
+            return x * n
+
+        @jax.jit
+        def g(x):
+            return float(x)         # concretizes a tracer
+    """)
+    assert rules_of(out).count("GL001") == 1
+
+
+def test_static_argname_params_not_tainted():
+    out = lint("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, *, k):
+            return x * int(k)
+    """)
+    assert "GL001" not in rules_of(out)
+
+
+def test_fused_step_maker_closure_is_traced():
+    # the gbdt pattern: jax.jit(_maker(...)) traces the returned closure
+    out = lint("""
+        import jax
+
+        def _step_body(lr):
+            def step(scores):
+                return float(scores) * lr
+            return step
+
+        def make(lr):
+            return jax.jit(_step_body(lr), donate_argnums=(0,))
+    """)
+    assert "GL001" in rules_of(out)
+
+
+def test_lax_scan_body_is_traced():
+    out = lint("""
+        import jax
+
+        def outer(xs):
+            def body(c, x):
+                return c, x.item()
+            return jax.lax.scan(body, 0, xs)
+    """)
+    assert "GL001" in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL002 jax-import-in-jax-free-module
+# ---------------------------------------------------------------------------
+
+def test_module_level_jax_import_in_jax_free_module():
+    out = lint("import jax\n", relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+
+
+def test_function_local_jax_import_is_fine():
+    out = lint("""
+        def f():
+            import jax
+            return jax
+    """, relpath="predict_fast.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_jax_free_module_importing_jaxful_module_flagged():
+    out = lint("from .models.gbdt import GBDT\n", relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+
+
+def test_jax_free_module_importing_jax_free_module_ok():
+    out = lint("from .models.tree import Tree\n", relpath="predict_fast.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_non_contract_module_may_import_jax():
+    out = lint("import jax\n", relpath="objectives.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_conditionally_guarded_module_level_jax_import_flagged():
+    # an `if`/`try` guard still executes at import time — only
+    # TYPE_CHECKING blocks are exempt (they never run)
+    out = lint("""
+        import os
+        if os.environ.get("X"):
+            import jax
+    """, relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+    out = lint("""
+        try:
+            import jax
+        except ImportError:
+            jax = None
+    """, relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+    out = lint("""
+        from typing import TYPE_CHECKING
+        if TYPE_CHECKING:
+            import jax
+    """, relpath="predict_fast.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_absolute_form_package_import_resolved():
+    # `from lightgbm_tpu.models.gbdt import ...` must flag exactly like
+    # the relative form
+    out = lint("from lightgbm_tpu.models.gbdt import GBDT\n",
+               relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+    out = lint("import lightgbm_tpu.models.gbdt\n",
+               relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+    out = lint("from lightgbm_tpu.models.tree import Tree\n",
+               relpath="predict_fast.py")
+    assert "GL002" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL003 float64-in-device-code
+# ---------------------------------------------------------------------------
+
+def test_float64_in_jit_flagged_host_ok():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float64)
+
+        def host(x):
+            return np.asarray(x, dtype=np.float64)
+    """)
+    assert rules_of(out).count("GL003") == 1
+
+
+def test_dtype_string_float64_in_jit_flagged():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.zeros(3, dtype="float64") + x
+    """)
+    assert "GL003" in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL004 jit-missing-static
+# ---------------------------------------------------------------------------
+
+def test_kwonly_param_without_static_flagged():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def f(x, *, max_bin: int = 255):
+            return x + max_bin
+    """)
+    assert "GL004" in rules_of(out)
+
+
+def test_kwonly_param_with_static_ok():
+    out = lint("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("max_bin",))
+        def f(x, *, max_bin: int = 255):
+            return x + max_bin
+    """)
+    assert "GL004" not in rules_of(out)
+
+
+def test_static_argnums_resolved_positionally():
+    out = lint("""
+        import jax
+
+        def f(x, n_pad: int):
+            return x[:n_pad]
+
+        g = jax.jit(f, static_argnums=1)
+    """)
+    assert "GL004" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL005 wallclock-or-rng-in-parity-path
+# ---------------------------------------------------------------------------
+
+def test_time_in_parity_module_flagged():
+    out = lint("""
+        import time
+
+        def f():
+            return time.time()
+    """, relpath="ops/grow.py")
+    assert "GL005" in rules_of(out)
+
+
+def test_np_random_in_parity_module_flagged():
+    out = lint("""
+        import numpy as np
+
+        def f(n):
+            return np.random.rand(n)
+    """, relpath="io/binning.py")
+    assert "GL005" in rules_of(out)
+
+
+def test_time_outside_parity_modules_ok():
+    out = lint("import time\nT0 = time.monotonic()\n",
+               relpath="serving/forest.py")
+    assert "GL005" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL006 unlocked-serving-mutation
+# ---------------------------------------------------------------------------
+
+_SERVING_SRC = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def locked_inc(self):
+            with self._lock:
+                self.n += 1
+
+        def unlocked_inc(self):
+            self.n += 1
+"""
+
+
+def test_unlocked_store_in_serving_flagged_locked_ok():
+    out = lint(_SERVING_SRC, relpath="serving/server.py")
+    assert rules_of(out).count("GL006") == 1
+
+
+def test_same_code_outside_serving_not_flagged():
+    out = lint(_SERVING_SRC, relpath="models/gbdt.py")
+    assert "GL006" not in rules_of(out)
+
+
+def test_subscript_mutation_of_shared_state_flagged():
+    # `self.requests[k] = ...` mutates shared state exactly like a
+    # plain store — the Metrics counter shape the rule exists to audit
+    out = lint("""
+        import threading
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.requests = {}
+                self.counts = [0, 0]
+
+            def unlocked(self, k):
+                self.requests[k] = self.requests.get(k, 0) + 1
+                self.counts[0] += 1
+
+            def locked(self, k):
+                with self._lock:
+                    self.requests[k] = self.requests.get(k, 0) + 1
+    """, relpath="serving/server.py")
+    assert rules_of(out).count("GL006") == 2
+
+
+# ---------------------------------------------------------------------------
+# GL007 global-jax-config-mutation
+# ---------------------------------------------------------------------------
+
+def test_x64_toggle_outside_entry_points_flagged():
+    src = """
+        import jax
+
+        def f():
+            jax.config.update("jax_enable_x64", True)
+    """
+    assert "GL007" in rules_of(lint(src, relpath="ops/predict.py"))
+    assert "GL007" not in rules_of(lint(src, relpath="cli.py"))
+
+
+def test_cache_dir_config_not_flagged():
+    out = lint("""
+        import jax
+
+        def f(d):
+            jax.config.update("jax_compilation_cache_dir", d)
+    """, relpath="utils/compile_cache.py")
+    assert "GL007" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL008 stdout-bypasses-logger
+# ---------------------------------------------------------------------------
+
+def test_print_in_library_flagged():
+    out = lint("def f():\n    print('hi')\n", relpath="models/gbdt.py")
+    assert "GL008" in rules_of(out)
+
+
+def test_logger_home_exempt():
+    out = lint("import sys\n\n\ndef w(m):\n    sys.stdout.write(m)\n",
+               relpath="utils/log.py")
+    assert "GL008" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# suppressions: GL009 / GL010 and the happy path
+# ---------------------------------------------------------------------------
+
+def test_justified_suppression_silences_finding():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            # graftlint: disable=GL003 -- f64 is this kernel's contract
+            # with the host accumulator (x64-only predict path)
+            return x.astype(jnp.float64)
+    """)
+    assert rules_of(out) == []
+
+
+def test_suppression_without_justification_is_gl009():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float64)  # graftlint: disable=GL003
+    """)
+    rules = rules_of(out)
+    assert "GL009" in rules       # bare suppression
+    assert "GL003" not in rules   # ... but it still suppresses
+
+
+def test_stale_suppression_is_gl010():
+    out = lint("""
+        def f(x):
+            # graftlint: disable=GL003 -- nothing here actually needs it
+            return x + 1
+    """)
+    assert rules_of(out) == ["GL010"]
+
+
+def test_multi_rule_suppression_reports_stale_half():
+    # disable=GL003,GL006 where only GL003 fires: the GL006 half is
+    # stale and must be reported (per-rule staleness, not per-comment)
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            # graftlint: disable=GL003,GL006 -- f64 contract holds here
+            return x.astype(jnp.float64)
+    """)
+    rules = rules_of(out)
+    assert "GL003" not in rules      # suppressed half works
+    assert rules.count("GL010") == 1  # stale GL006 half reported
+
+
+def test_unknown_rule_in_suppression_is_gl009():
+    out = lint("""
+        def f(x):
+            return x + 1  # graftlint: disable=GL999 -- no such rule here
+    """)
+    assert "GL009" in rules_of(out)
+
+
+def test_suppression_inside_docstring_ignored():
+    out = lint('''
+        def f(x):
+            """Example: # graftlint: disable=GL003 -- doc text only."""
+            return x + 1
+    ''')
+    assert rules_of(out) == []
+
+
+# ---------------------------------------------------------------------------
+# typegate
+# ---------------------------------------------------------------------------
+
+def test_typegate_flags_missing_annotations():
+    out = check_source(textwrap.dedent("""
+        def f(a, b: int):
+            return a + b
+    """))
+    msgs = [f.message for f in out]
+    assert any("unannotated parameter" in m and "a" in m for m in msgs)
+    assert any("missing return annotation" in m for m in msgs)
+
+
+def test_typegate_accepts_annotated_and_init():
+    out = check_source(textwrap.dedent("""
+        class C:
+            def __init__(self, x: int):
+                self.x = x
+
+            def get(self) -> int:
+                return self.x
+    """))
+    assert out == []
+
+
+def test_typegate_zero_param_init_needs_return_annotation():
+    # mypy only infers -> None for __init__ when at least one param is
+    # annotated; a bare `def __init__(self):` is untyped under strict
+    out = check_source(textwrap.dedent("""
+        class C:
+            def __init__(self):
+                self.x = 1
+    """))
+    assert any("missing return annotation" in f.message for f in out)
+    out = check_source(textwrap.dedent("""
+        class C:
+            def __init__(self) -> None:
+                self.x = 1
+    """))
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# the gates scripts/lint.sh relies on: the repo itself is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_is_graftlint_clean():
+    findings = run_graftlint()
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_repo_passes_typegate():
+    findings = run_typegate()
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)

@@ -12,6 +12,7 @@ byte-identical stand-ins, which is itself asserted here.
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from contextlib import contextmanager
@@ -544,3 +545,72 @@ def test_multi_client_stress_mixed_modes_and_reloads(tmp_path):
             t.join(30)
     assert not errs, errs[:3]
     assert checked[0] > 100
+
+
+def test_invalid_content_length_is_400_not_hang(tmp_path):
+    """Negative Content-Length once made rfile.read() block until client
+    disconnect (pinning the handler thread + in-flight gauge); garbage
+    lengths fell through to 500.  Both must 400 and drop the
+    connection, and the server must keep serving afterwards."""
+    import http.client
+
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        host, port = srv.address
+        for bad in ("-1", "abc"):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Length", bad)
+            conn.endheaders()
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 400, (bad, body)
+            conn.close()
+        st, out = post(srv.url, "/predict", _tsv_body(_rows(n=3)))
+        assert st == 200 and len(out.splitlines()) == 3
+
+
+def test_shutdown_before_serve_forever_does_not_deadlock(tmp_path):
+    """shutdown() on a constructed-but-never-started server must return
+    (BaseServer.shutdown() would otherwise wait forever on the event
+    only serve_forever sets)."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    cfg = Config.from_params({"task": "serve", "input_model": model,
+                              "serve_port": "0"})
+    server = ServingServer(cfg)
+    t0 = time.monotonic()
+    server.shutdown(drain_timeout=2.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_metrics_timestamp_keeps_full_precision(tmp_path):
+    """The loaded-at gauge must render enough digits for a staleness
+    alert ("%g" truncated a unix timestamp to ~hour resolution)."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model) as srv:
+        loaded_at = srv.state.forest.loaded_at
+        st, metrics = get(srv.url, "/metrics")
+    assert st == 200
+    for line in metrics.decode().splitlines():
+        if line.startswith("lgbm_serve_model_loaded_timestamp_seconds "):
+            val = float(line.split()[-1])
+            assert abs(val - loaded_at) < 0.001, line
+            break
+    else:
+        raise AssertionError("timestamp gauge missing")
+
+
+def test_sniff_sep_handles_first_line_longer_than_window():
+    """_sniff_sep must widen until it holds complete lines — the same
+    partial-line rule predict_fast._sniff_format got in PR 2 (a >64KiB
+    first line was sniffed truncated, as if it were whole)."""
+    from lightgbm_tpu.serving.server import _sniff_sep
+
+    long_line = b"1," + b",".join(b"0.5" for _ in range(40000)) + b"\n"
+    assert len(long_line) > (1 << 16)
+    body = long_line + b"0,0.1,0.2\n"
+    fmt, sep = _sniff_sep(body)
+    assert (fmt, sep) == ("csv", ",")
+    # and a body that IS one giant unterminated line still resolves
+    fmt, sep = _sniff_sep(long_line.rstrip(b"\n"))
+    assert (fmt, sep) == ("csv", ",")
