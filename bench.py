@@ -89,7 +89,7 @@ def build_dataset(cfg, x, y):
     from lightgbm_tpu.io.dataset import Dataset, Metadata
 
     rng = np.random.RandomState(SEED)
-    sample = rng.choice(N_ROWS, 50_000, replace=False)
+    sample = rng.choice(N_ROWS, min(50_000, N_ROWS), replace=False)
     mappers = find_bins(x[sample], len(sample), cfg.max_bin)
     bins = np.stack([m.value_to_bin(x[:, j]).astype(np.uint8)
                      for j, m in enumerate(mappers)])
@@ -298,39 +298,40 @@ def run_reference_rank():
     return res
 
 
-def run_ours_bagged():
-    """Bagged + feature-fraction run (VERDICT r2 #3): exercises the
-    packed-mask upload and the device stopped-flag deferral — no
-    per-iteration host sync on this path since round 3."""
+def _measure_bagged(cfg, ds, prefix, num_trees=NUM_TREES, warm_iters=6):
+    """One bagged training measurement with the symmetric reporting
+    every other family gets: <prefix>_steady_s (min(chunk) * chunks),
+    <prefix>_wall_s (raw loop) and <prefix>_compile_s (warm-up wall —
+    compile or persistent-cache load).  warm_iters must span one
+    re-bagging boundary so the re-bag mask plumbing (and under
+    bag_compact the in-bag-first arrangement dispatch) compiles outside
+    the timed loop."""
     import jax
-    from lightgbm_tpu.config import Config
     from lightgbm_tpu.models.gbdt import create_boosting
     from lightgbm_tpu.objectives import create_objective
 
-    x, y = make_data()
-    cfg = Config.from_params({**_params(), "bagging_fraction": "0.8",
-                              "bagging_freq": "5",
-                              "feature_fraction": "0.8"})
-    ds = build_dataset(cfg, x, y)
-    obj = create_objective(cfg)
-    obj.init(ds.metadata, ds.num_data)
-    # warm bagging_freq + 1 iterations: the ordered-partition bagged
-    # path uses distinct executables for the first (re-sorting) step,
-    # the steady steps, and the re-bagging mask permute (first fired at
-    # iteration bagging_freq) — all must compile (or load from the
-    # persistent cache) outside the timed loop
-    warm = create_boosting(cfg, ds, obj)
-    for _ in range(6):
+    def fresh():
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        return create_boosting(cfg, ds, obj)
+
+    warm = fresh()
+    t0 = time.time()
+    for _ in range(warm_iters):
         warm.train_one_iter(None, None, False)
     jax.block_until_ready(warm.scores)
+    compile_s = time.time() - t0
     del warm
-    booster = create_boosting(cfg, ds, obj)
+    booster = fresh()
     # chunked min*chunks steady timing like every family (VERDICT r4
     # #6: the r4 bagged number fell 2.16 -> 1.48 partly on unchunked
-    # single-shot timing soaking up tunnel stalls); each 25-tree chunk
-    # spans five bagging_freq=5 re-bag cycles, so chunks are uniform
-    chunks = 4
-    per = NUM_TREES // chunks
+    # single-shot timing soaking up tunnel stalls); chunking requires
+    # each chunk to span WHOLE bagging_freq re-bag cycles, else chunks
+    # carry unequal re-bag/arrange dispatch counts and min(chunk)*chunks
+    # underestimates steady time
+    freq = max(int(cfg.bagging_freq), 1)
+    chunks = 4 if num_trees % (4 * freq) == 0 else 1
+    per = num_trees // chunks
     chunk_s = []
     t_all = time.time()
     for _ in range(chunks):
@@ -340,8 +341,60 @@ def run_ours_bagged():
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
-    return {"bagged_train_s": min(chunk_s) * chunks,
-            "bagged_wall_s": time.time() - t_all}
+    return {prefix + "_steady_s": min(chunk_s) * chunks,
+            prefix + "_wall_s": time.time() - t_all,
+            prefix + "_compile_s": round(compile_s, 3)}
+
+
+def run_ours_bagged():
+    """Bagged + feature-fraction run (VERDICT r2 #3): exercises the
+    packed-mask upload, the device stopped-flag deferral, and (round 9)
+    the bag-compacted fused step when bag_compact engages."""
+    from lightgbm_tpu.config import Config
+
+    x, y = make_data()
+    cfg = Config.from_params({**_params(), "bagging_fraction": "0.8",
+                              "bagging_freq": "5",
+                              "feature_fraction": "0.8"})
+    ds = build_dataset(cfg, x, y)
+    res = _measure_bagged(cfg, ds, "bagged")
+    # continuity key: earlier rounds' BASELINE entries read bagged_train_s
+    res["bagged_train_s"] = res["bagged_steady_s"]
+    return res
+
+
+# bagging_fraction sweep (0.25 / 0.5 / 0.8, compact vs masked): the
+# machine-checked scaling claim — bagged histogram work should track the
+# fraction under bag_compact, not stay flat at the full-N sweep cost
+SWEEP_TREES = int(os.environ.get("BENCH_SWEEP_TREES", 40))
+
+
+def run_bagged_sweep():
+    """Per-fraction steady times with bag_compact on vs off on identical
+    data/bins, plus the on/off speedup — recorded in BENCH_*.json so the
+    'histogram work scales with bagging_fraction' claim is checked every
+    round."""
+    from lightgbm_tpu.config import Config
+
+    x, y = make_data()
+    base = Config.from_params(_params())
+    ds = build_dataset(base, x, y)
+    out = {}
+    for frac in ("0.25", "0.5", "0.8"):
+        times = {}
+        for mode in ("on", "off"):
+            cfg = Config.from_params({
+                **_params(), "bagging_fraction": frac,
+                "bagging_freq": "5", "bag_compact": mode})
+            res = _measure_bagged(cfg, ds, "tmp", num_trees=SWEEP_TREES)
+            times[mode] = res["tmp_steady_s"]
+            key = "bag_sweep_f%s_%s" % (
+                frac, "compact" if mode == "on" else "masked")
+            out[key + "_steady_s"] = round(res["tmp_steady_s"], 3)
+        out["bag_sweep_f%s_compact_speedup" % frac] = round(
+            times["off"] / times["on"], 4)
+    out["bag_sweep_trees"] = SWEEP_TREES
+    return out
 
 
 def run_reference_bagged():
@@ -849,16 +902,29 @@ def main():
     if os.environ.get("BENCH_BAGGED", "1") != "0":
         try:
             bo = run_ours_bagged()
-            br = run_reference_bagged()
             extras.update({
                 "bagged_train_s": round(bo["bagged_train_s"], 3),
+                "bagged_steady_s": round(bo["bagged_steady_s"], 3),
                 "bagged_wall_s": round(bo["bagged_wall_s"], 3),
+                "bagged_compile_s": bo["bagged_compile_s"],
+            })
+            br = run_reference_bagged()
+            extras.update({
                 "ref_bagged_train_s": br["ref_bagged_train_s"],
                 "bagged_vs_baseline": round(
                     br["ref_bagged_train_s"] / bo["bagged_train_s"], 4),
             })
         except Exception as e:
             extras["bagged_error"] = str(e)[:200]
+
+    # the fraction sweep is independently gated: it builds its own data
+    # and must keep machine-checking the scaling claim even when the
+    # slower reference-vs-ours bagged comparison is skipped
+    if os.environ.get("BENCH_BAG_SWEEP", "1") != "0":
+        try:
+            extras.update(run_bagged_sweep())
+        except Exception as e:
+            extras["bag_sweep_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_FAMILIES", "1") != "0":
         # remaining reference workload families (VERDICT r3 #4):
@@ -939,7 +1005,8 @@ def main():
     # readers never have to guess.
     conventions = {"vs_baseline": "wall", "vs_baseline_steady": "steady"}
     for k in extras:
-        if k.endswith("_vs_baseline") or k.endswith("_vs_general"):
+        if k.endswith("_vs_baseline") or k.endswith("_vs_general") \
+                or k.endswith("_compact_speedup"):
             conventions[k] = "steady"
     if "predict_vs_baseline" in extras:
         # file-to-file predict has no chunked loop; both sides are

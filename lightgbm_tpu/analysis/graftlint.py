@@ -43,6 +43,13 @@ Rules (README.md "Static analysis & invariants" has the full table):
         no (or a trivial) `-- why` justification.
   GL010 unused-suppression         a disable comment whose rule did not
         actually fire on that line — stale suppressions rot.
+  GL011 static-bag-shape           a bag-count/bag-size name treated as
+        a TRACED value: `int()`/`.item()` on one inside a traced
+        function, or a bag-size parameter of a jitted signature missing
+        from static_argnames.  Bag counts are deterministic (mt19937
+        host draws; config.bag_compact ceil_pads them into static
+        windows), so they are SHAPE inputs — tracing one would retrace
+        the fused step at every re-bagging epoch.
 
 Suppression syntax (GL009/GL010 verify it):
 
@@ -72,7 +79,15 @@ RULES: Dict[str, str] = {
     "GL008": "stdout-bypasses-logger",
     "GL009": "suppression-missing-justification",
     "GL010": "unused-suppression",
+    "GL011": "static-bag-shape",
 }
+
+# Names that hold a bag count / compacted-window size (the static-bag-
+# shape contract, GL011).  Deliberately does NOT match bag_mask/bag_masks
+# — masks are genuine traced row data; it is the COUNTS that are shapes.
+BAG_SIZE_RE = re.compile(
+    r"(^|_)(bag|compact)_?(rows|cnt|count|size|window)($|_)",
+    re.IGNORECASE)
 
 # Rules about the suppression mechanism itself can never be suppressed.
 UNSUPPRESSABLE = {"GL009", "GL010"}
@@ -203,6 +218,17 @@ def _dotted(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+def _names_bag_size(node: ast.AST) -> bool:
+    """Does this expression reference a bag-count/bag-size name (GL011)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and BAG_SIZE_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) \
+                and BAG_SIZE_RE.search(sub.attr):
+            return True
+    return False
 
 
 def _attach_parents(tree: ast.AST) -> None:
@@ -557,9 +583,17 @@ class ModuleLint:
                 name = _dotted(n.func)
                 if isinstance(n.func, ast.Attribute) \
                         and n.func.attr == "item" and not n.args:
-                    self._emit(n, "GL001",
-                               ".item() forces a device->host sync "
-                               "inside a traced function")
+                    if _names_bag_size(n.func.value):
+                        self._emit(n, "GL011",
+                                   ".item() on a bag count inside a "
+                                   "traced function: bag counts are "
+                                   "STATIC shapes (host mt19937 draws, "
+                                   "ceil_padded windows) — keep them "
+                                   "Python ints outside the trace")
+                    else:
+                        self._emit(n, "GL001",
+                                   ".item() forces a device->host sync "
+                                   "inside a traced function")
                 elif name in _HOST_SYNC_CALLS:
                     self._emit(n, "GL001",
                                "%s inside a traced function is a host "
@@ -567,10 +601,18 @@ class ModuleLint:
                                "the trace)" % name)
                 elif name in ("float", "int", "bool") and len(n.args) == 1:
                     if _expr_tainted(n.args[0], taint_for(fn)):
-                        self._emit(n, "GL001",
-                                   "%s() on a traced value concretizes "
-                                   "it (host sync / tracer error)"
-                                   % name)
+                        if _names_bag_size(n.args[0]):
+                            self._emit(n, "GL011",
+                                       "%s() on a traced bag count: bag "
+                                       "counts are STATIC shapes — "
+                                       "compute them on the host and "
+                                       "close over them (or pass via "
+                                       "static_argnames)" % name)
+                        else:
+                            self._emit(n, "GL001",
+                                       "%s() on a traced value "
+                                       "concretizes it (host sync / "
+                                       "tracer error)" % name)
             # float64 mentions in device code
             if isinstance(n, ast.Attribute) \
                     and _dotted(n) in _F64_ATTRS:
@@ -603,6 +645,17 @@ class ModuleLint:
                 if i == 0 and p.arg in ("self", "cls"):
                     continue
                 if p.arg in statics:
+                    continue
+                if BAG_SIZE_RE.search(p.arg):
+                    # the static-bag-shape contract: a bag-size argument
+                    # reaching a jitted signature non-statically would
+                    # retrace the executable at every re-bagging epoch
+                    self._emit(
+                        fn, "GL011",
+                        "jit of %r: bag-size parameter %r is not in "
+                        "static_argnames — the compacted window must be "
+                        "a static shape (zero recompiles across "
+                        "re-bagging boundaries)" % (fn.name, p.arg))
                     continue
                 confy = p.arg in kwonly
                 d = defaults.get(p.arg)

@@ -194,6 +194,16 @@ class Config:
     #                                       leaves every hist_reorder_every
     #                                       trees (serial pallas learner)
     hist_reorder_every: int = 16          # trees between row re-sorts
+    bag_compact: str = "auto"             # auto | on | off: bag-compacted fused
+    #                                       training — in-bag rows arranged into
+    #                                       a contiguous static window at every
+    #                                       re-bagging so histogram/grow work
+    #                                       scales with bagging_fraction; auto
+    #                                       engages when bagging is on,
+    #                                       bagging_fraction <= 0.8 and
+    #                                       hist_dtype=float32 (the f64 parity
+    #                                       configuration keeps the masked
+    #                                       full-sweep oracle)
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
@@ -344,6 +354,7 @@ class Config:
         set_str("hist_compact")
         set_str("hist_ordered")
         set_int("hist_reorder_every")
+        set_str("bag_compact")
         set_bool("donate_buffers")
         set_str("device_type")
         set_str("serve_host")
@@ -376,6 +387,9 @@ class Config:
         if c.hist_ordered not in ("auto", "off"):
             log.fatal("Unknown hist_ordered %s (expect auto|off)"
                       % c.hist_ordered)
+        if c.bag_compact not in ("auto", "on", "off"):
+            log.fatal("Unknown bag_compact %s (expect auto|on|off)"
+                      % c.bag_compact)
         if c.hist_dtype not in ("float32", "float64"):
             log.fatal("Unknown hist_dtype %s (expect float32|float64)"
                       % c.hist_dtype)

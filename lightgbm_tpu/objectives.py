@@ -104,11 +104,34 @@ class Objective:
         inside the fused reorder step (models/gbdt.py), so two
         objectives with equal fused_key must return functions that trace
         identically.  Default: every state leaf is per-row on its last
-        axis (regression/binary/multiclass)."""
+        axis (regression/binary/multiclass).
+
+        This is also the bag-compaction gather hook: the in-bag-first
+        arrangement (models/gbdt.py _arrange_for_bag) is a stable row
+        permutation, so grad_state follows it through this same function
+        — objectives whose state carries row indices (lambdarank's
+        doc_idx) remap them here and need nothing extra for compaction."""
         def permute(gstate, rel):
             return jax.tree_util.tree_map(
                 lambda a: jnp.take(a, rel, axis=-1), gstate)
         return permute
+
+    def bag_rows_bound(self, bagging_fraction: float) -> int:
+        """Deterministic upper bound on the in-bag ROW count of any
+        single re-bagging draw at this fraction — the static size of the
+        bag-compacted sweep window (models/gbdt.py).  Row-granular
+        bagging draws exactly int(fraction * n) rows (gbdt.cpp:109-131),
+        so the bound is exact; query-granular bagging (query_boundaries
+        present, gbdt.cpp:133-160) draws int(nq * fraction) whole
+        queries whose row total varies per draw — bounded by the sum of
+        the largest that-many query lengths."""
+        qb = getattr(self.metadata, "query_boundaries", None)
+        if qb is None:
+            return int(bagging_fraction * self.num_data)
+        qb = np.asarray(qb, dtype=np.int64)
+        qlen = np.sort(qb[1:] - qb[:-1])[::-1]
+        bag_query_cnt = int(len(qlen) * bagging_fraction)
+        return int(qlen[:bag_query_cnt].sum())
 
     # -- query-granular sharding surface (tree_learner=data) -----------
     # Objectives whose grad_state is NOT per-row on its last axis (the

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import leaf_histogram, make_gvals
+from .predict import predict_leaf_binned
 from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
                     per_feature_best)
 
@@ -608,3 +609,51 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     final, _ = jax.lax.scan(step, state,
                             jnp.arange(1, max_leaves, dtype=jnp.int32))
     return final.tree, final.leaf_id
+
+
+def grow_tree_bagged(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
+                     bag_mask: jax.Array, feature_mask: jax.Array, *,
+                     bag_rows: int = 0, **grow_kw):
+    """Bag-compacted grow_tree entry (the fused-path fast path when
+    bagging leaves a fixed fraction of rows out of every tree).
+
+    Rows arrive pre-arranged in-bag-first (models/gbdt.py
+    _arrange_for_bag): every in-bag row lives in the static window
+    [0, bag_rows), so histogram sweeps, the leaf_id partition compares
+    and the whole grow scan run over bag_rows rows instead of N.
+    `bag_rows` is a PYTHON int (static under jit — graftlint GL011
+    guards this), so the window slice shapes are stable across
+    re-bagging epochs and the executable never retraces.
+
+    Out-of-bag tail rows no longer ride leaf_id through the scan: their
+    leaf assignment comes from one vectorized binned descent over the
+    complement — a cheap O(tail * depth) traversal traded for the
+    dominant O(N * leaves) histogram cost, exactly the reference's
+    two-path score update (partition fast path + OOB traversal,
+    src/boosting/gbdt.cpp:162-167).  The returned leaf_id still covers
+    ALL rows (window ids from the scan, tail ids from the descent; the
+    two agree bit-for-bit with a full-row scan, which routes rows by
+    the same compares).
+
+    Under shard_map (psum_axis set) everything added here is
+    shard-local — the descent has no collectives — so per-shard bag
+    compaction preserves the psum pairing invariants untouched.
+
+    bag_rows <= 0 or >= N falls through to the plain masked full sweep
+    (the bit-parity oracle)."""
+    n = bins_t.shape[1]
+    if bag_rows <= 0 or bag_rows >= n:
+        return grow_tree(bins_t, grad, hess, bag_mask, feature_mask,
+                         **grow_kw)
+    tree, leaf_w = grow_tree(bins_t[:, :bag_rows], grad[:bag_rows],
+                             hess[:bag_rows], bag_mask[:bag_rows],
+                             feature_mask, **grow_kw)
+    oob = predict_leaf_binned(tree.split_feature, tree.threshold_bin,
+                              tree.left_child, tree.right_child,
+                              bins_t[:, bag_rows:])
+    # a 1-leaf stump's all-zero child arrays make the bounded descent
+    # return the dummy ~0 = -1; the scan's leaf_id keeps such rows at
+    # leaf 0 (whose value drives the score update), so mirror it — the
+    # two paths must agree row-for-row with the masked full sweep
+    oob = jnp.maximum(oob, 0)
+    return tree, jnp.concatenate([leaf_w, oob.astype(leaf_w.dtype)])

@@ -51,7 +51,11 @@ N_LO = 32
 N_COMP = 3    # grad, hess, count
 M_ROWS = MM_FEATS * N_COMP * N_HI   # 96
 N_COLS = MM_FEATS * N_LO            # 128
-PALLAS_ROW_BLOCK = 8192   # rows per grid step; N must be a multiple
+PALLAS_ROW_BLOCK = 8192   # rows per grid step; N must be a multiple —
+#                           this is also the alignment of the
+#                           bag-compacted sweep window (models/gbdt.py
+#                           pads the static in-bag window to it), so the
+#                           kernels never see a partial block
 
 
 def _feat_block(f: int) -> int:

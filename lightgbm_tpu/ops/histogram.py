@@ -12,6 +12,11 @@ Layout: bins are stored feature-major [F, N] uint8 (the reference is also
 column-major, include/LightGBM/feature.h) so each lax.map step streams one
 contiguous feature row.
 
+Row-count generality: N here is whatever window the caller sweeps — the
+full padded row count, or the bag-compacted in-bag window (ops/grow.py
+grow_tree_bagged), which under bagging is ~bagging_fraction * N.  Nothing
+in this module assumes a particular N beyond the shapes it is handed.
+
 A Pallas kernel with VMEM-blocked accumulation is the planned fast path for
 large N; this XLA formulation is the portable baseline and the correctness
 oracle for it.

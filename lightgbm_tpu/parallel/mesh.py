@@ -265,6 +265,19 @@ class ShardedGrower:
             self._permute[arr.ndim] = fn
         return fn(arr, order)
 
+    def shard_row_counts(self, mask: np.ndarray, n_pad: int) -> np.ndarray:
+        """Per-LOCAL-shard True counts of a host row mask (file/layout
+        order, padded to this process's n_pad rows) — the bag-compaction
+        window overflow check (models/gbdt.py).  Shard membership is
+        position-fixed (every device-side re-sort, including the
+        in-bag-first arrangement, is shard-local), so the static
+        contiguous blocks of the padded layout ARE the shards."""
+        m = np.asarray(mask, dtype=bool)
+        if m.shape[-1] < n_pad:
+            m = np.pad(m, (0, n_pad - m.shape[-1]))
+        local = self.local_shard_count()
+        return m.reshape(local, n_pad // local).sum(axis=1)
+
     # -- multi-host helpers (jax.process_count() > 1) -------------------
     def replicate(self, arr) -> jax.Array:
         """Host array (identical on every process) -> replicated global."""

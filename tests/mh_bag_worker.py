@@ -1,0 +1,66 @@
+"""Worker for the multi-host BAGGED bag-compaction test
+(test_bag_compact.py::test_compact_multihost_bagged_two_process).
+
+Usage: python mh_bag_worker.py <rank> <nproc> <port> <data> <out_prefix>
+
+Each worker owns 4 virtual CPU devices, joins jax.distributed, loads its
+lottery row shard, and trains tree_learner=data with bagging through the
+MULTI-HOST fused sharded step twice: bag_compact=off (the masked oracle)
+and bag_compact=on (per-shard static windows + shard-local in-bag-first
+arrangement).  Saves <out_prefix>_off.txt / <out_prefix>_on.txt and
+prints compact_engaged=<0|1> for the compact run.
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4], sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    # cross-process collectives on the CPU backend need the gloo
+    # implementation (without it the compiler rejects multiprocess
+    # computations outright on CPU-only boxes)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+for mode in ("off", "on"):
+    cfg = Config.from_params({
+        "objective": "binary", "tree_learner": "data", "num_leaves": "8",
+        "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+        "hist_dtype": "float64", "metric": "",
+        "bagging_fraction": "0.5", "bagging_freq": "2",
+        "bag_compact": mode, "is_save_binary_file": "false"})
+    ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = create_boosting(cfg, ds, obj)
+    assert booster._mh_fused and booster._can_fuse(), \
+        "multi-host data-parallel must take the fused sharded path"
+    for _ in range(4):   # spans two re-bagging boundaries (freq=2)
+        booster.train_one_iter(None, None, False)
+    if mode == "on":
+        engaged = int(bool(booster._bag_window)
+                      and booster._bag_arranged
+                      and not booster._bag_overflowed)
+        print("compact_engaged=%d window=%s" % (engaged,
+                                                booster._bag_window))
+    booster.save_model_to_file(-1, True, "%s_%s.txt" % (out, mode))
+print("worker %d done" % rank)

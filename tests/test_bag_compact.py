@@ -1,0 +1,473 @@
+"""Bag-compacted fused training (config.bag_compact): the compacted
+window path must reproduce the masked full-sweep oracle.
+
+Parity convention (the repo's established oracle bar): at
+hist_dtype=float64 — the parity configuration — compact-on models match
+compact-off in STRUCTURE (split features, threshold bins, leaf counts)
+exactly and in leaf values to f64 reassociation noise (<= 1e-9
+relative), across {binary, regression, multiclass, lambdarank} x
+{hist_impl xla, pallas} x {hist_ordered auto, off}, plus
+tree_learner=data.  The f32 spot checks mirror the hist_ordered e2e
+tests: few rounds, structure-exact.  The zero-recompile test pins the
+static-bag-shape contract (the whole point of the ceil_pad window:
+re-bagging must never retrace).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.gbdt import _unpack_bag, _unpack_bag_jit
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _binary_data(n, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+def _data_for(objective, n, seed=0):
+    """(x, y, group) for one parity axis."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 6).astype(np.float32)
+    signal = x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.3 * rng.randn(n)
+    if objective == "binary":
+        return x, (signal > 0).astype(np.float32), None
+    if objective == "regression":
+        return x, signal.astype(np.float32), None
+    if objective == "multiclass":
+        edges = np.quantile(signal, [1 / 3, 2 / 3])
+        return x, np.digitize(signal, edges).astype(np.float32), None
+    assert objective == "lambdarank"
+    y = np.clip(np.round(signal + 1.5), 0, 4).astype(np.float32)
+    return x, y, np.full(n // 16, 16, dtype=np.int32)
+
+
+def _params_for(objective):
+    p = {"objective": objective, "num_leaves": 15, "max_bin": 63,
+         "min_data_in_leaf": 20, "learning_rate": 0.1, "metric": ""}
+    if objective == "multiclass":
+        p.update(num_class=3, metric="multi_logloss", num_leaves=7)
+    return p
+
+
+def _train(params, x, y, group=None, rounds=5):
+    ds = lgb.Dataset(x, label=y, group=group)
+    return lgb.train(params, ds, num_boost_round=rounds,
+                     verbose_eval=False)
+
+
+def assert_models_match(b_off, b_on, value_rtol=1e-9):
+    """Structure exact; leaf values to `value_rtol` (None = skip values:
+    the f32 configurations accumulate in different groupings)."""
+    ms_off, ms_on = b_off._gbdt.models, b_on._gbdt.models
+    assert len(ms_off) == len(ms_on)
+    for i, (t1, t2) in enumerate(zip(ms_off, ms_on)):
+        np.testing.assert_array_equal(
+            t1.split_feature_real, t2.split_feature_real,
+            err_msg="tree %d split features" % i)
+        np.testing.assert_array_equal(t1.threshold_bin, t2.threshold_bin,
+                                      err_msg="tree %d thresholds" % i)
+        np.testing.assert_array_equal(t1.leaf_count, t2.leaf_count,
+                                      err_msg="tree %d leaf counts" % i)
+        if value_rtol is not None:
+            np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                       rtol=value_rtol, atol=1e-12,
+                                       err_msg="tree %d leaf values" % i)
+
+
+# ---------------------------------------------------------------------------
+# _unpack_bag round-trip (shared helper next to _pack_tree — satellite)
+# ---------------------------------------------------------------------------
+
+def test_unpack_bag_packbits_roundtrip():
+    """The bit-packed bag upload (8x less host->device traffic) must
+    round-trip np.packbits exactly, for every n_pad % 8 residue, and
+    pass bool masks through untouched."""
+    rng = np.random.RandomState(3)
+    for n in (8, 24, 96, 1000, 1001, 1007):
+        mask = rng.rand(n) < 0.37
+        n_pad = -(-n // 8) * 8
+        padded = np.zeros(n_pad, dtype=bool)
+        padded[:n] = mask
+        packed = jnp.asarray(np.packbits(padded))
+        got = np.asarray(_unpack_bag(packed, n_pad))
+        np.testing.assert_array_equal(got, padded)
+        got_jit = np.asarray(_unpack_bag_jit(packed, n_pad))
+        np.testing.assert_array_equal(got_jit, padded)
+        # bool passthrough: already-unpacked (ordered/arranged) masks
+        # must come back as the SAME value
+        same = _unpack_bag(jnp.asarray(padded), n_pad)
+        np.testing.assert_array_equal(np.asarray(same), padded)
+
+
+def test_bag_rows_bound_row_and_query_granular():
+    """Window bound hook: exact for row bagging; top-k query-length sum
+    for query bagging (objectives.Objective.bag_rows_bound)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config.from_params({"objective": "regression"})
+    obj = create_objective(cfg)
+    obj.init(Metadata(label=np.zeros(1000, dtype=np.float32)), 1000)
+    assert obj.bag_rows_bound(0.5) == 500
+    assert obj.bag_rows_bound(0.25) == 250
+
+    rcfg = Config.from_params({"objective": "lambdarank"})
+    robj = create_objective(rcfg)
+    qb = np.asarray([0, 10, 30, 60, 100], dtype=np.int32)  # lens 10,20,30,40
+    labels = np.zeros(100, dtype=np.float32)
+    robj.init(Metadata(label=labels, query_boundaries=qb), 100)
+    # 2 of 4 queries drawn: worst case = the two longest (40 + 30)
+    assert robj.bag_rows_bound(0.5) == 70
+    assert robj.bag_rows_bound(0.25) == 40
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix: {objective} x {hist_impl} x {hist_ordered}
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("objective",
+                         ["binary", "regression", "multiclass",
+                          "lambdarank"])
+@pytest.mark.parametrize("ordered", ["auto", "off"])
+def test_compact_matches_masked_xla(objective, ordered):
+    """f64 parity configuration, hist_impl=xla (bag_compact=on forces
+    compaction there — auto reserves f64 for the masked oracle): full
+    structural identity plus leaf values to f64 reassociation noise,
+    across two re-bagging boundaries and (multiclass) the union-window
+    per-class masks."""
+    n = 3000 if objective != "lambdarank" else 3200
+    x, y, group = _data_for(objective, n, seed=11)
+    # multiclass windows hold the UNION of the per-class draws (K x the
+    # per-class count), so only small fractions leave a window < N
+    frac = 0.25 if objective == "multiclass" else 0.5
+    common = {**_params_for(objective), "hist_impl": "xla",
+              "hist_dtype": "float64", "bagging_fraction": frac,
+              "bagging_freq": 2, "hist_ordered": ordered}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, group,
+                   rounds=6)
+    b_on = _train({**common, "bag_compact": "on"}, x, y, group, rounds=6)
+    g = b_on._gbdt
+    assert g._bag_window and g._bag_arranged and not g._bag_overflowed
+    assert b_off._gbdt._bag_window == 0   # the oracle stayed masked
+    assert_models_match(b_off, b_on)
+    xt = np.random.RandomState(5).randn(200, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(b_off.predict(xt)),
+                               np.asarray(b_on.predict(xt)), rtol=1e-9,
+                               atol=1e-12)
+
+
+@pytest.mark.parametrize("objective", ["binary", "lambdarank"])
+@pytest.mark.parametrize("ordered", ["auto", "off"])
+def test_compact_matches_masked_pallas(objective, ordered):
+    """Pallas (interpret mode on CPU) f32: the window pads to the 8192
+    row block, and under hist_ordered=auto the block-list ranged sweeps
+    + window-local re-sorts compose with compaction.  f32 accumulation
+    groupings differ between window and full sweeps, so the bar is the
+    hist_ordered e2e one: few rounds, structure-exact, predictions to
+    f32 association noise."""
+    n = 8192 * 2
+    x, y, group = _data_for(objective, n, seed=4)
+    common = {**_params_for(objective), "hist_impl": "pallas",
+              "hist_dtype": "float32", "bagging_fraction": 0.4,
+              "bagging_freq": 2, "hist_ordered": ordered,
+              "hist_reorder_every": 2}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, group,
+                   rounds=3)
+    b_on = _train({**common, "bag_compact": "auto"}, x, y, group,
+                  rounds=3)
+    g = b_on._gbdt
+    assert g._bag_window == 8192 and g._bag_arranged
+    assert_models_match(b_off, b_on, value_rtol=None)
+    xt = np.random.RandomState(5).randn(200, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(b_off.predict(xt)),
+                               np.asarray(b_on.predict(xt)), atol=2e-5)
+
+
+@pytest.mark.parametrize("objective", ["regression", "multiclass"])
+def test_compact_matches_masked_pallas_more_objectives(objective):
+    """The remaining parity-matrix objectives on the Pallas kernel
+    (ordered=auto; the ordered=off leg of these objectives is covered
+    by the xla matrix above — ranged sweeps only exist under pallas)."""
+    n = 8192 * 2
+    x, y, group = _data_for(objective, n, seed=4)
+    # the multiclass union window (K x per-class count) must still fit
+    # under the 8192-row Pallas block for compaction to engage at this N
+    frac = 0.15 if objective == "multiclass" else 0.25
+    common = {**_params_for(objective), "hist_impl": "pallas",
+              "hist_dtype": "float32", "bagging_fraction": frac,
+              "bagging_freq": 2, "hist_ordered": "auto",
+              "hist_reorder_every": 2}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, group,
+                   rounds=3)
+    b_on = _train({**common, "bag_compact": "auto"}, x, y, group,
+                  rounds=3)
+    assert b_on._gbdt._bag_window and b_on._gbdt._bag_arranged
+    assert_models_match(b_off, b_on, value_rtol=None)
+
+
+def test_compact_dart_banked_matches_masked():
+    """DART's banked fused path under compaction: the leaf bank rides
+    the in-bag-first arrangement (drop/normalize gathers read it by row
+    position), and trees must match the masked banked run."""
+    x, y = _binary_data(2000, f=5, seed=11)
+    common = {"objective": "binary", "boosting_type": "dart",
+              "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 20,
+              "drop_rate": 0.3, "metric": "", "hist_dtype": "float64",
+              "bagging_fraction": 0.5, "bagging_freq": 2}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, rounds=10)
+    b_on = _train({**common, "bag_compact": "on"}, x, y, rounds=10)
+    g = b_on._gbdt
+    assert g._bank is not None and g._bag_window and g._bag_arranged
+    assert_models_match(b_off, b_on)
+
+
+def test_compact_sharded_data_parallel_matches_masked():
+    """tree_learner=data (single-host, 8 virtual devices): per-shard
+    in-bag-first arrangement + per-shard static windows; every in-bag
+    row lands in exactly one shard's window, so the psum'd histograms
+    equal the masked sharded run's."""
+    n = 4096
+    x, y = _binary_data(n, seed=2)
+    common = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "",
+              "tree_learner": "data", "hist_dtype": "float64",
+              "bagging_fraction": 0.5, "bagging_freq": 2}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, rounds=6)
+    b_on = _train({**common, "bag_compact": "on"}, x, y, rounds=6)
+    g = b_on._gbdt
+    assert g._fused_sharded and g._bag_window and g._bag_arranged
+    assert not g._bag_overflowed
+    # per-shard window strictly under the shard cap: work actually drops
+    assert g._bag_window < g.n_pad // g.grower.local_shard_count()
+    assert_models_match(b_off, b_on)
+
+
+@pytest.mark.parametrize("objective", ["lambdarank", "multiclass"])
+def test_compact_sharded_layout_and_union_matches_masked(objective):
+    """The two tree_learner=data compositions the binary sharded test
+    cannot reach: lambdarank's query-granular layout (layout-active
+    gstate specs in the sharded arrange, layout-placed overflow
+    counting) and multiclass's union window through
+    _make_bag_arrange_sharded's [K, N] mask handling."""
+    n = 4096
+    x, y, group = _data_for(objective, n, seed=6)
+    frac = 0.25 if objective == "multiclass" else 0.5
+    common = {**_params_for(objective), "tree_learner": "data",
+              "hist_dtype": "float64", "bagging_fraction": frac,
+              "bagging_freq": 2}
+    b_off = _train({**common, "bag_compact": "off"}, x, y, group,
+                   rounds=4)
+    b_on = _train({**common, "bag_compact": "on"}, x, y, group,
+                  rounds=4)
+    g = b_on._gbdt
+    assert g._fused_sharded and g._bag_window and g._bag_arranged
+    assert not g._bag_overflowed
+    if objective == "lambdarank":
+        assert g._layout_active   # the query-granular rank layout ran
+    assert_models_match(b_off, b_on)
+
+
+def test_compact_custom_gradient_excursion_restores():
+    """Leaving the fused path mid-run (custom file-order gradients)
+    restores file order; coming back re-arranges for the CURRENT bag.
+    Trees must match the masked run making the same excursion."""
+    n = 3000
+    x, y = _binary_data(n, seed=1)
+
+    def fobj(scores, ds):
+        lab = 2.0 * np.asarray(ds.get_label()) - 1.0
+        r = -2.0 * lab / (1.0 + np.exp(2.0 * lab * np.asarray(scores)))
+        return r, np.abs(r) * (2.0 - np.abs(r))
+
+    common = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "",
+              "hist_dtype": "float64", "bagging_fraction": 0.5,
+              "bagging_freq": 2}
+    models = []
+    for compact in ("off", "on"):
+        ds = lgb.Dataset(x, label=y)
+        bst = lgb.Booster({**common, "bag_compact": compact}, ds)
+        for it in range(6):
+            if it in (2, 3):
+                bst.update(fobj=lambda preds, data: fobj(preds, ds))
+            else:
+                bst.update()
+        models.append(bst._gbdt.models)
+    for i, (t_off, t_on) in enumerate(zip(*models)):
+        np.testing.assert_array_equal(t_off.split_feature_real,
+                                      t_on.split_feature_real,
+                                      err_msg="tree %d" % i)
+        np.testing.assert_array_equal(t_off.threshold_bin,
+                                      t_on.threshold_bin,
+                                      err_msg="tree %d" % i)
+
+
+def test_compact_checkpoint_resume_bit_exact():
+    """Mid-epoch checkpoint under compaction resumes bit-for-bit: the
+    snapshot stores file-order state + the composed (arranged) row
+    order + the bag_arranged flag, so the restored booster continues on
+    the exact same accumulation order."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    n = 2000
+    x, y = _binary_data(n, seed=9)
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "",
+              "bagging_fraction": 0.5, "bagging_freq": 2,
+              "bag_compact": "on", "num_iterations": 8}
+    ds = lgb.Dataset(x, label=y, params=params)
+
+    def fresh():
+        cfg = Config.from_params({k: str(v) for k, v in params.items()})
+        inner = ds.inner
+        obj = create_objective(cfg)
+        obj.init(inner.metadata, inner.num_data)
+        return create_boosting(cfg, inner, obj)
+
+    import tempfile
+    ck = os.path.join(tempfile.mkdtemp(), "bagck.npz")
+    a = fresh()
+    for _ in range(3):            # save INSIDE a bag epoch (freq=2)
+        a.train_one_iter(None, None, False)
+    assert a._bag_arranged
+    a.save_checkpoint(ck)
+    for _ in range(5):
+        a.train_one_iter(None, None, False)
+
+    b = fresh()
+    b.load_checkpoint(ck)
+    assert b._bag_arranged
+    for _ in range(5):
+        b.train_one_iter(None, None, False)
+
+    ma, mb = a.models, b.models
+    assert len(ma) == len(mb) == 8
+    for t1, t2 in zip(ma, mb):
+        assert t1.to_string() == t2.to_string()
+
+
+def test_compact_auto_gating():
+    """auto engages at f32 + fraction <= 0.8 on the fused path; stays
+    off for the f64 parity configuration, for fraction > 0.8, and with
+    bagging disabled."""
+    x, y = _binary_data(1200, seed=3)
+    base = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+            "min_data_in_leaf": 20, "metric": ""}
+
+    def window(extra):
+        b = _train({**base, **extra}, x, y, rounds=2)
+        return b._gbdt._bag_window
+
+    assert window({"bagging_fraction": 0.5, "bagging_freq": 2}) == 600
+    assert window({"bagging_fraction": 0.9, "bagging_freq": 2}) == 0
+    assert window({"bagging_fraction": 0.5, "bagging_freq": 2,
+                   "hist_dtype": "float64"}) == 0
+    assert window({}) == 0                       # bagging off
+    # bag_compact=on overrides the auto f64 exclusion
+    assert window({"bagging_fraction": 0.5, "bagging_freq": 2,
+                   "hist_dtype": "float64", "bag_compact": "on"}) == 600
+
+
+# ---------------------------------------------------------------------------
+# the static-bag-shape contract: zero recompiles across re-baggings
+# ---------------------------------------------------------------------------
+
+def test_compact_zero_recompiles_across_rebag_boundaries(xla_guard):
+    """The bag count is deterministic, so the compacted window is a
+    STATIC shape: after warm-up, two further re-bagging boundaries (mask
+    redraw + in-bag-first arrangement + compacted fused steps) must
+    trigger ZERO XLA compiles — re-arranging is a dispatch, never a
+    retrace."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    n = 2400
+    x, y = _binary_data(n, seed=8)
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "",
+              "bagging_fraction": 0.5, "bagging_freq": 2,
+              "bag_compact": "on", "num_iterations": 16}
+    ds = lgb.Dataset(x, label=y, params=params)
+    cfg = Config.from_params({k: str(v) for k, v in params.items()})
+    inner = ds.inner
+    obj = create_objective(cfg)
+    obj.init(inner.metadata, inner.num_data)
+    booster = create_boosting(cfg, inner, obj)
+    # warm-up: one full re-bag cycle + the boundary of the next compiles
+    # the arrangement, the compacted step, and the re-bag mask plumbing
+    for _ in range(5):
+        booster.train_one_iter(None, None, False)
+    jax.block_until_ready(booster.scores)
+    assert booster._bag_arranged and booster._bag_window == 1200
+    with xla_guard(0, what="compacted fused steps across two "
+                          "re-bagging boundaries"):
+        for _ in range(4):   # iterations 5..8: re-bags at 6 and 8
+            booster.train_one_iter(None, None, False)
+        jax.block_until_ready(booster.scores)
+
+
+def test_compact_multihost_bagged_two_process(tmp_path):
+    """REAL multi-host bagged run (mh_worker-style): 2 jax processes x 4
+    virtual CPU devices train tree_learner=data with bagging through the
+    fused sharded step, compact on AND off in each worker; both ranks
+    must agree, and compact must reproduce the masked models."""
+    import socket as socketlib
+    import subprocess
+    import sys
+
+    rng = np.random.RandomState(0)
+    n, ncol = 800, 5
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+
+    s = socketlib.socket()
+    s.bind(("localhost", 0))
+    port = str(s.getsockname()[1])
+    s.close()
+
+    outs = [str(tmp_path / ("model_%d" % r)) for r in range(2)]
+    worker = os.path.join(os.path.dirname(__file__), "mh_bag_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), "2", port, str(data), outs[r]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+
+    off0 = open(outs[0] + "_off.txt").read()
+    on0 = open(outs[0] + "_on.txt").read()
+    assert off0 == open(outs[1] + "_off.txt").read(), \
+        "ranks diverged (masked)"
+    assert on0 == open(outs[1] + "_on.txt").read(), \
+        "ranks diverged (compact)"
+    # compact vs masked: same structure lines tree by tree
+    for key in ("num_leaves", "split_feature", "threshold"):
+        off_lines = [ln for ln in off0.splitlines()
+                     if ln.startswith(key + "=")]
+        on_lines = [ln for ln in on0.splitlines()
+                    if ln.startswith(key + "=")]
+        assert off_lines == on_lines, "compact changed %s" % key
+    assert "compact_engaged=1" in logs[0] and "compact_engaged=1" in logs[1]

@@ -498,6 +498,97 @@ def test_typegate_zero_param_init_needs_return_annotation():
 
 
 # ---------------------------------------------------------------------------
+# GL011 static-bag-shape
+# ---------------------------------------------------------------------------
+
+def test_gl011_nonstatic_bag_size_jit_param_flagged():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(scores, bag_rows):
+            return scores[:bag_rows]
+    """)
+    assert "GL011" in rules_of(out)
+
+
+def test_gl011_static_bag_size_jit_param_clean():
+    out = lint("""
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("bag_rows",))
+        def step(scores, bag_rows):
+            return scores[:bag_rows]
+    """)
+    assert "GL011" not in rules_of(out)
+
+
+def test_gl011_bag_mask_param_not_a_bag_size():
+    """Masks are genuine traced row data — only COUNT/SIZE names are
+    static shapes."""
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(scores, bag_mask):
+            return scores * bag_mask
+    """)
+    assert "GL011" not in rules_of(out)
+
+
+def test_gl011_int_on_traced_bag_count_flagged_over_gl001():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(bag_cnt, scores):
+            return scores[:int(bag_cnt)]
+    """)
+    rules = rules_of(out)
+    assert "GL011" in rules
+    assert "GL001" not in rules     # the specific rule wins
+
+
+def test_gl011_item_on_bag_window_attr_flagged():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(state):
+            w = state.bag_window.item()
+            return w
+    """)
+    assert "GL011" in rules_of(out)
+
+
+def test_gl011_int_on_plain_traced_value_still_gl001():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return int(x)
+    """)
+    rules = rules_of(out)
+    assert "GL001" in rules and "GL011" not in rules
+
+
+def test_gl011_suppressible_with_justification():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(scores, bag_rows):  # graftlint: disable=GL011 -- \
+bench-only probe; retrace per epoch is the point being measured
+            return scores[:bag_rows]
+    """)
+    assert "GL011" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
 # the gates scripts/lint.sh relies on: the repo itself is clean
 # ---------------------------------------------------------------------------
 

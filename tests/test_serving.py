@@ -412,26 +412,53 @@ def test_chunked_body_is_refused_cleanly(tmp_path):
 
 
 def test_drain_finishes_inflight_work(tmp_path):
+    """Graceful drain must complete work that is ALREADY dispatched when
+    shutdown starts.  Deterministic via an event handshake (no
+    wall-clock coupling — the old version polled in_flight inside a
+    200 ms batching window and flaked on 2-core containers when the
+    request finished before the poll observed it): the batcher's
+    run_batch is gated, so the request is provably mid-dispatch when
+    the drain begins, and only the drain itself releases it."""
     model = _write(tmp_path / "m.txt", BINARY_MODEL)
     body = _tsv_body(_rows(n=200))
-    srv_cm = serve(model, serve_batch_timeout_ms=200)
+    srv_cm = serve(model, serve_batch_timeout_ms=0)
     srv = srv_cm.__enter__()
+    dispatched = threading.Event()
+    release = threading.Event()
+    inner = srv.state.batcher._run
+
+    def gated(key, payloads):
+        dispatched.set()
+        assert release.wait(30), "drain never released the dispatch"
+        return inner(key, payloads)
+
+    srv.state.batcher._run = gated
     try:
         got = []
         t = threading.Thread(target=lambda: got.append(
             post(srv.url, "/predict", body)))
         t.start()
-        # wait until the request is genuinely in flight (inside the
-        # 200ms batching window) before starting the drain
-        import time
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
-            if srv.state.metrics.in_flight >= 1:
-                break
-            time.sleep(0.005)
+        # the request is genuinely in flight: its dispatch has started
+        # and is now blocked on `release`
+        assert dispatched.wait(30)
         assert srv.state.metrics.in_flight >= 1
     finally:
-        srv_cm.__exit__(None, None, None)   # graceful drain
+        # start the graceful drain WHILE the dispatch is in flight; the
+        # drain blocks on it, so release from a side thread — but only
+        # once the drain has PROVABLY begun (state.draining flips first
+        # thing in ServingServer.shutdown), so the property under test
+        # (drain completes already-dispatched work) cannot be dodged by
+        # the dispatch finishing before the drain starts
+        drainer = threading.Thread(
+            target=lambda: srv_cm.__exit__(None, None, None))
+        drainer.start()
+        deadline = time.monotonic() + 30
+        while not srv.state.draining and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert srv.state.draining, "drain never started"
+        release.set()
+        drainer.join(30)
+        assert not drainer.is_alive(), "drain did not complete"
     t.join(15)
     assert got and got[0][0] == 200
     assert len(got[0][1].splitlines()) == 200
