@@ -76,11 +76,46 @@ def holdout_data():
     return x, y
 
 
+# iteration batching (config.iter_batch): the bench drives training
+# through GBDT.train_segment like cli/api do, so the K-scan dispatch
+# win is what gets measured; BENCH_ITER_BATCH=1 is the per-iteration
+# oracle for A/B runs
+ITER_BATCH = os.environ.get("BENCH_ITER_BATCH", "auto")
+# trees for the instrumented dispatch/transfer probe (a short post-run
+# pass on warm executables; 24 = 3 full auto-K segments + one deferred
+# flush boundary)
+PROBE_TREES = int(os.environ.get("BENCH_PROBE_TREES", 24))
+
+
+def _drive(booster, n):
+    """Segment-batched training loop: K iterations per device dispatch
+    (train_segment), host sync only at flush boundaries — the same
+    path the cli/api drivers run."""
+    done = 0
+    while done < n:
+        _, k = booster.train_segment(n - done, is_eval=False)
+        done += k
+
+
+def _warm_n(booster, per, floor):
+    """Warm-up length: with batching OFF (K=1 — e.g. iter_batch=auto on
+    CPU) the historical two iterations cover the {reorder, plain}
+    executables; with batching ON a FULL chunk is needed — the segment
+    tiling dispatches several distinct lengths (steady K, re-sort K=1,
+    remainders) and any executable not warmed compiles inside the timed
+    loop.  chunks==1 families pay one extra chunk of training for that
+    guarantee (cheap on accelerators, where batching is on)."""
+    if booster._iter_batch_k() <= 1:
+        return max(floor, 2)
+    return max(floor, per)
+
+
 def _params():
     return {
         "objective": "binary", "num_leaves": str(NUM_LEAVES),
         "max_bin": str(MAX_BIN), "min_data_in_leaf": str(MIN_DATA_IN_LEAF),
         "learning_rate": str(LEARNING_RATE), "metric": "",
+        "iter_batch": ITER_BATCH,
     }
 
 
@@ -107,6 +142,9 @@ def run_ours():
     from lightgbm_tpu.models.gbdt import create_boosting
     from lightgbm_tpu.objectives import create_objective
 
+    from lightgbm_tpu.analysis.guards import track_compiles
+    from lightgbm_tpu.models.gbdt import dispatch_count
+
     x, y = make_data()
     cfg = Config.from_params(_params())
 
@@ -117,18 +155,27 @@ def run_ours():
     booster = create_boosting(cfg, ds, obj)
     setup_s = time.time() - t0
 
-    # warm-up: TWO iterations on a throwaway booster trigger all XLA
-    # compilations (cached by shape for the real run).  Two, not one:
-    # under ordered-partition growth iteration 1 dispatches the
-    # REORDER step variant and iteration 2 the plain variant
-    # (gbdt._run_fused), so a single-iteration warm-up left the plain
-    # step's ~20s cold compile inside the timed loop.
+    # warm-up: ONE FULL CHUNK on a throwaway booster triggers all XLA
+    # compilations (cached by shape for the real run).  A whole chunk,
+    # not two iterations: iteration batching tiles a chunk with several
+    # distinct segment lengths (the steady K, the re-sort K=1 dispatch,
+    # the between-resort remainder), and every one of those executables
+    # must compile outside the timed loop.  The warm-up runs under
+    # track_compiles so compile_s splits cold vs cache-warm: a prior
+    # run of this shape leaves zero persistent-cache misses and
+    # compile_s collapses to deserialization time.
+    chunks = 4
+    assert NUM_TREES % chunks == 0, "chunked timing needs chunks | NUM_TREES"
+    per = NUM_TREES // chunks
     warm = create_boosting(cfg, ds, obj)
     t0 = time.time()
-    for _ in range(2):
-        warm.train_one_iter(None, None, False)
-    jax.block_until_ready(warm.scores)
+    with track_compiles() as cstats:
+        _drive(warm, _warm_n(warm, per, 2))
+        jax.block_until_ready(warm.scores)
     compile_s = time.time() - t0
+    compile_cache = ("cache-warm" if cstats.cache_misses == 0
+                     and cstats.cache_hits > 0 else
+                     "cold" if cstats.cache_misses > 0 else "disabled")
     del warm
 
     # The remote-attached TPU tunnel occasionally stalls for tens of
@@ -137,20 +184,29 @@ def run_ours():
     # throughput (min chunk x 4) as the headline, with the raw total
     # alongside — transient tunnel stalls are an environment artifact,
     # not framework cost.
-    chunks = 4
-    assert NUM_TREES % chunks == 0, "chunked timing needs chunks | NUM_TREES"
-    per = NUM_TREES // chunks
     t_all = time.time()
     chunk_s = []
     for _ in range(chunks):
         t0 = time.time()
-        for _ in range(per):
-            booster.train_one_iter(None, None, False)
+        _drive(booster, per)
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))  # force full completion
         chunk_s.append(time.time() - t0)
     train_total_s = time.time() - t_all
     train_s = min(chunk_s) * chunks
+
+    # instrumented probe on warm executables: dispatches-per-tree and
+    # device->host pulls for the training loop (the K-scan win as a
+    # tracked metric, not a one-off) — guards count the explicit
+    # device_get flushes, gbdt counts its own dispatches
+    probe = create_boosting(cfg, ds, obj)
+    d0 = dispatch_count()
+    with track_compiles() as pstats:
+        _drive(probe, PROBE_TREES)
+        flushed = len(probe.models)    # materializes -> final device_get
+    assert flushed == PROBE_TREES
+    probe_dispatches = dispatch_count() - d0
+    del probe
 
     model_path = os.path.join(CACHE, "bench_model.txt")
     booster.save_model_to_file(-1, True, model_path)
@@ -164,7 +220,16 @@ def run_ours():
     auc = ((ranks[yh == 1].sum() - npos * (npos - 1) / 2)
            / (npos * (len(yh) - npos)))
     return {"train_s": train_s, "train_total_s": train_total_s,
-            "compile_s": compile_s, "setup_s": setup_s,
+            "compile_s": compile_s, "compile_cache": compile_cache,
+            "compile_cache_hits": cstats.cache_hits,
+            "compile_cache_misses": cstats.cache_misses,
+            "setup_s": setup_s,
+            "iter_batch": ITER_BATCH,
+            "dispatches_per_tree": round(
+                probe_dispatches / PROBE_TREES, 4),
+            "device_gets_per_100_trees": round(
+                pstats.device_gets * 100.0 / PROBE_TREES, 2),
+            "probe_trees": PROBE_TREES,
             "auc": float(auc), "backend": jax.default_backend(),
             "model_path": model_path}
 
@@ -183,6 +248,7 @@ def _rank_params():
         "objective": "lambdarank", "num_leaves": str(RANK_LEAVES),
         "max_bin": str(MAX_BIN), "min_data_in_leaf": str(MIN_DATA_IN_LEAF),
         "learning_rate": str(LEARNING_RATE), "metric": "",
+        "iter_batch": ITER_BATCH,
     }
 
 
@@ -223,13 +289,14 @@ def _run_rank_workload(prefix, extra_params=None, force_general=False):
             obj.row_shardable = False
         return create_boosting(cfg, ds, obj)
 
-    # TWO warm-up iterations, same reason as the binary family
-    # (run_ours): lambdarank is row_permutable since round 5, so
-    # iteration 1 dispatches the REORDER step variant and iteration 2
-    # the plain variant — both must compile outside the timed loop
+    # ONE-CHUNK warm-up, same reason as the binary family (run_ours):
+    # iteration batching tiles a chunk with several distinct segment
+    # lengths (reorder K=1, the steady K, remainders) and every one
+    # must compile outside the timed loop
+    chunks = 4
+    per = NUM_TREES // chunks
     warm = fresh()
-    for _ in range(2):
-        warm.train_one_iter(None, None, False)
+    _drive(warm, _warm_n(warm, per, 2))
     jax.block_until_ready(warm.scores)
     del warm
 
@@ -238,14 +305,11 @@ def _run_rank_workload(prefix, extra_params=None, force_general=False):
     # single transient tunnel stall otherwise masquerades as training
     # time (the r4 rank regression 2.9 s -> 6.0 s was exactly this
     # failure mode — unchunked single-shot timing)
-    chunks = 4
-    per = NUM_TREES // chunks
     chunk_s = []
     t_all = time.time()
     for _ in range(chunks):
         t0 = time.time()
-        for _ in range(per):
-            booster.train_one_iter(None, None, False)
+        _drive(booster, per)
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
@@ -315,10 +379,19 @@ def _measure_bagged(cfg, ds, prefix, num_trees=NUM_TREES, warm_iters=6):
         obj.init(ds.metadata, ds.num_data)
         return create_boosting(cfg, ds, obj)
 
+    # iteration batching slices a bag epoch into {K = freq} segments
+    # (plus reorder/remainder dispatches under ordered mode); warm one
+    # full chunk so every segment executable compiles outside the loop
+    freq = max(int(cfg.bagging_freq), 1)
+    chunks = 4 if num_trees % (4 * freq) == 0 else 1
+    per = num_trees // chunks
     warm = fresh()
     t0 = time.time()
-    for _ in range(warm_iters):
-        warm.train_one_iter(None, None, False)
+    # a full chunk under batching (the bag/reorder boundary offsets
+    # produce several distinct segment lengths, and any remainder
+    # executable not warmed here would compile inside the timed loop);
+    # the historical warm_iters with batching off
+    _drive(warm, _warm_n(warm, per, warm_iters))
     jax.block_until_ready(warm.scores)
     compile_s = time.time() - t0
     del warm
@@ -329,15 +402,11 @@ def _measure_bagged(cfg, ds, prefix, num_trees=NUM_TREES, warm_iters=6):
     # each chunk to span WHOLE bagging_freq re-bag cycles, else chunks
     # carry unequal re-bag/arrange dispatch counts and min(chunk)*chunks
     # underestimates steady time
-    freq = max(int(cfg.bagging_freq), 1)
-    chunks = 4 if num_trees % (4 * freq) == 0 else 1
-    per = num_trees // chunks
     chunk_s = []
     t_all = time.time()
     for _ in range(chunks):
         t0 = time.time()
-        for _ in range(per):
-            booster.train_one_iter(None, None, False)
+        _drive(booster, per)
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
@@ -760,10 +829,16 @@ def _run_ours_workload(params, x, y, num_trees, field, warm_iters=1):
     ds = build_dataset(cfg, x, y)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
+    # chunk-length warm-up (see run_ours): the segment tiling must
+    # compile every executable it will use before the timed loop
+    chunks = 4 if num_trees % 4 == 0 else 1
+    per = num_trees // chunks
     warm = create_boosting(cfg, ds, obj)
     t0 = time.time()
-    for _ in range(warm_iters):
-        warm.train_one_iter(None, None, False)
+    # a FULL chunk under batching: anything shorter can miss the
+    # remainder-segment executable (e.g. K=8 tiling per=25 as 8,8,8,1 —
+    # the K=1 compile would land inside the first timed chunk)
+    _drive(warm, _warm_n(warm, per, warm_iters))
     jax.block_until_ready(warm.scores)
     compile_s = time.time() - t0
     del warm
@@ -771,14 +846,11 @@ def _run_ours_workload(params, x, y, num_trees, field, warm_iters=1):
     # chunked min*chunks like the headline loop: the remote TPU tunnel's
     # transient multi-second stalls (see run_ours) otherwise swallow a
     # whole family's number
-    chunks = 4 if num_trees % 4 == 0 else 1
-    per = num_trees // chunks
     chunk_s = []
     t_all = time.time()
     for _ in range(chunks):
         t0 = time.time()
-        for _ in range(per):
-            booster.train_one_iter(None, None, False)
+        _drive(booster, per)
         jax.block_until_ready(booster.scores)
         float(np.asarray(booster.scores[0, 0]))
         chunk_s.append(time.time() - t0)
@@ -1024,6 +1096,12 @@ def main():
         "train_steady_s": round(ours["train_s"], 3),
         "vs_baseline_steady": round(ref_s / ours["train_s"], 4),
         "compile_s": round(ours["compile_s"], 3),
+        "compile_cache": ours["compile_cache"],
+        "compile_cache_hits": ours["compile_cache_hits"],
+        "compile_cache_misses": ours["compile_cache_misses"],
+        "iter_batch": ours["iter_batch"],
+        "dispatches_per_tree": ours["dispatches_per_tree"],
+        "device_gets_per_100_trees": ours["device_gets_per_100_trees"],
         "auc_holdout": round(ours["auc"], 5),
         "backend": ours["backend"],
         "ncpu": os.cpu_count(),

@@ -50,6 +50,14 @@ Rules (README.md "Static analysis & invariants" has the full table):
         host draws; config.bag_compact ceil_pads them into static
         windows), so they are SHAPE inputs — tracing one would retrace
         the fused step at every re-bagging epoch.
+  GL012 host-sync-in-scan-carry    `.item()` / `int()`/`float()`/
+        `bool()` / `np.asarray` / `jax.device_get` on a scan carry or
+        per-iteration value inside a lax.scan body — the iteration-
+        batched training loop (config.iter_batch) exists to remove the
+        per-iteration host round-trip, and a host sync inside the scan
+        body is a tracer error at best and a silent K-fold serialization
+        at worst.  Wins over GL001 inside scan bodies (GL011 still wins
+        for bag counts).
 
 Suppression syntax (GL009/GL010 verify it):
 
@@ -80,6 +88,14 @@ RULES: Dict[str, str] = {
     "GL009": "suppression-missing-justification",
     "GL010": "unused-suppression",
     "GL011": "static-bag-shape",
+    "GL012": "host-sync-in-scan-carry",
+}
+
+# lax.scan-family transforms whose body argument is a scan body (GL012:
+# host syncs there serialize every batched iteration, not just one)
+_SCAN_NAMES = {
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.associative_scan", "lax.associative_scan",
 }
 
 # Names that hold a bag count / compacted-window size (the static-bag-
@@ -299,6 +315,7 @@ class _TraceIndex:
         for d in self.defs:
             self.by_name.setdefault(d.name, []).append(d)
         self.traced: Set[ast.AST] = set()
+        self.scan_bodies: Set[ast.AST] = set()
         self.statics: Dict[ast.AST, Set[str]] = {}
         self.jit_roots: List[Tuple[ast.AST, Set[str]]] = []
         self._factories: Set[ast.AST] = set()
@@ -410,6 +427,28 @@ class _TraceIndex:
                     elif isinstance(arg, ast.Call):
                         for d in self._local_def_from_expr(arg, assigned):
                             self._factories.add(d)
+                if name in _SCAN_NAMES and n.args:
+                    # the FIRST argument is the scan body: host syncs on
+                    # its carry/xs serialize every batched iteration
+                    # (GL012).  Resolve the name LEXICALLY — prefer defs
+                    # in the scan call's own enclosing functions, then
+                    # module level — so an unrelated same-named def
+                    # elsewhere (`def body` is a common inner-fn name)
+                    # is not misclassified as a scan body.
+                    body = n.args[0]
+                    if isinstance(body, ast.Lambda):
+                        self.scan_bodies.add(body)
+                    elif isinstance(body, ast.Name):
+                        cands = self.by_name.get(body.id, [])
+                        encl = set(_enclosing_functions(n))
+                        scoped = [d for d in cands
+                                  if getattr(d, "_gl_parent", None)
+                                  in encl]
+                        if not scoped:
+                            scoped = [d for d in cands if isinstance(
+                                getattr(d, "_gl_parent", None),
+                                ast.Module)]
+                        self.scan_bodies.update(scoped or cands)
 
         for d in self.defs:
             if TRACED_FACTORY_NAMES.match(d.name):
@@ -450,18 +489,28 @@ class _TraceIndex:
         while changed:
             changed = False
             for d in self.defs:
-                if d in self.traced:
-                    continue
                 for anc in _enclosing_functions(d):
-                    if anc in self.traced:
+                    if anc in self.traced and d not in self.traced:
                         self.traced.add(d)
                         changed = True
-                        break
+                    if anc in self.scan_bodies \
+                            and d not in self.scan_bodies:
+                        # nested helpers inside a scan body inherit its
+                        # carry discipline (GL012)
+                        self.scan_bodies.add(d)
+                        changed = True
 
     def is_traced(self, node: ast.AST) -> bool:
         """Is this (non-def) node's innermost enclosing function traced?"""
         for fn in _enclosing_functions(node):
             return fn in self.traced
+        return False
+
+    def in_scan_body(self, node: ast.AST) -> bool:
+        """Is this node's innermost enclosing function a lax.scan body
+        (or nested inside one)?"""
+        for fn in _enclosing_functions(node):
+            return fn in self.scan_bodies
         return False
 
     def innermost(self, node: ast.AST) -> Optional[ast.AST]:
@@ -579,6 +628,11 @@ class ModuleLint:
             fn = idx.innermost(n)
             if fn is None or fn not in idx.traced:
                 continue
+            # inside a lax.scan body the host-sync rules sharpen to
+            # GL012: the sync lands on a scan carry / per-iteration
+            # value and serializes EVERY batched iteration (GL011's
+            # bag-count classification still wins)
+            sync_rule = "GL012" if idx.in_scan_body(n) else "GL001"
             if isinstance(n, ast.Call):
                 name = _dotted(n.func)
                 if isinstance(n.func, ast.Attribute) \
@@ -590,15 +644,28 @@ class ModuleLint:
                                    "STATIC shapes (host mt19937 draws, "
                                    "ceil_padded windows) — keep them "
                                    "Python ints outside the trace")
+                    elif sync_rule == "GL012":
+                        self._emit(n, "GL012",
+                                   ".item() on a scan carry/per-"
+                                   "iteration value inside a scanned "
+                                   "training-loop body — host sync "
+                                   "serializes every batched iteration")
                     else:
                         self._emit(n, "GL001",
                                    ".item() forces a device->host sync "
                                    "inside a traced function")
                 elif name in _HOST_SYNC_CALLS:
-                    self._emit(n, "GL001",
-                               "%s inside a traced function is a host "
-                               "round-trip (use jnp / keep it outside "
-                               "the trace)" % name)
+                    if sync_rule == "GL012":
+                        self._emit(n, "GL012",
+                                   "%s inside a lax.scan body is a host "
+                                   "sync on scan state — it would "
+                                   "serialize every iteration of the "
+                                   "batched training loop" % name)
+                    else:
+                        self._emit(n, "GL001",
+                                   "%s inside a traced function is a "
+                                   "host round-trip (use jnp / keep it "
+                                   "outside the trace)" % name)
                 elif name in ("float", "int", "bool") and len(n.args) == 1:
                     if _expr_tainted(n.args[0], taint_for(fn)):
                         if _names_bag_size(n.args[0]):
@@ -608,6 +675,13 @@ class ModuleLint:
                                        "compute them on the host and "
                                        "close over them (or pass via "
                                        "static_argnames)" % name)
+                        elif sync_rule == "GL012":
+                            self._emit(n, "GL012",
+                                       "%s() on a scan carry/per-"
+                                       "iteration value concretizes it "
+                                       "inside the scanned training "
+                                       "loop (tracer error / K-fold "
+                                       "host sync)" % name)
                         else:
                             self._emit(n, "GL001",
                                        "%s() on a traced value "

@@ -16,7 +16,16 @@ Counted signals:
     a new (shape, config) key, whether or not the backend compile is
     later served from the persistent cache.  This is the recompile
     signal the invariants are stated in.
-  * stats.backend_compiles — actual XLA compilations.
+  * stats.backend_compiles — XLA backend compile records.  CAVEAT: the
+    dispatch timing record fires for persistent-cache DESERIALIZATION
+    too, so this over-counts on cache-warm processes — use the
+    cache_hits/cache_misses pair to split them.
+  * stats.cache_hits / cache_misses — persistent compilation cache
+    probes (jax lru_cache "Cache hit for key" records and the
+    compiler's "PERSISTENT COMPILATION CACHE MISS" records).  A fresh
+    process of an already-seen shape shows misses == 0: the cross-run
+    zero-compile claim (tests/test_cache_cross_process.py, and
+    bench.py's compile_s cold/cache-warm split).
   * stats.device_puts / device_gets — explicit jax.device_put /
     jax.device_get calls made through the `jax` module attributes
     (wrapped for the duration).  Implicit transfers are policed by the
@@ -61,6 +70,8 @@ class GuardViolation(AssertionError):
 class GuardStats:
     lowerings: List[str] = dataclasses.field(default_factory=list)
     backend_compiles: List[str] = dataclasses.field(default_factory=list)
+    cache_hits: int = 0
+    cache_misses: int = 0
     device_puts: int = 0
     device_gets: int = 0
 
@@ -73,16 +84,27 @@ class GuardStats:
         if len(self.lowerings) > 8:
             names += ", ... (%d total)" % len(self.lowerings)
         return ("%d compile(s) [%s], %d backend compile(s), "
-                "%d device_put, %d device_get"
+                "%d cache hit(s)/%d miss(es), %d device_put, "
+                "%d device_get"
                 % (self.compiles, names, len(self.backend_compiles),
+                   self.cache_hits, self.cache_misses,
                    self.device_puts, self.device_gets))
 
 
 _COMPILING_RE = re.compile(r"Compiling (\S+)")
 _FINISHED_RE = re.compile(r"Finished XLA compilation of (\S+)")
-# jax loggers that carry the two records (jax 0.4.x: lowering logs from
-# interpreters.pxla, backend-compile timing from dispatch)
-_LOGGER_NAMES = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+# persistent-cache probe records: the hit comes from the cache backend
+# ("Cache hit for key: ..."), the authoritative miss from the compiler
+# ("PERSISTENT COMPILATION CACHE MISS ..." — the backend also logs a
+# lowercase "Cache miss for key" for the same probe, which is ignored
+# so a miss counts once)
+_CACHE_HIT_RE = re.compile(r"Cache hit for key")
+_CACHE_MISS_RE = re.compile(r"PERSISTENT COMPILATION CACHE MISS")
+# jax loggers that carry the records (jax 0.4.x: lowering logs from
+# interpreters.pxla, backend-compile timing from dispatch, persistent-
+# cache probes from lru_cache/compiler)
+_LOGGER_NAMES = ("jax._src.interpreters.pxla", "jax._src.dispatch",
+                 "jax._src.lru_cache", "jax._src.compiler")
 
 
 class _CaptureHandler(logging.Handler):
@@ -99,6 +121,11 @@ class _CaptureHandler(logging.Handler):
         m = _FINISHED_RE.search(msg)
         if m:
             self._stats.backend_compiles.append(m.group(1))
+            return
+        if _CACHE_HIT_RE.search(msg):
+            self._stats.cache_hits += 1
+        elif _CACHE_MISS_RE.search(msg):
+            self._stats.cache_misses += 1
 
 
 @contextlib.contextmanager

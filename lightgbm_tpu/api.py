@@ -630,10 +630,22 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     gbdt = booster._gbdt
     gbdt.config.metric_freq = freq if freq > 0 else (1 << 30)
     early = gbdt.early_stopping_round > 0
-    for _ in range(num_boost_round):
-        stop = booster.update(fobj=fobj)
-        if not stop and (freq > 0 or early):
-            stop = gbdt.eval_and_check_early_stopping()
-        if stop:
-            break
+    is_eval = freq > 0 or early
+    done = 0
+    stop = False
+    while done < num_boost_round and not stop:
+        if fobj is not None:
+            # custom gradients stay per-iteration (their evolution is
+            # host-driven, outside the scanned segment)
+            stop = booster.update(fobj=fobj)
+            done += 1
+            if not stop and is_eval:
+                stop = gbdt.eval_and_check_early_stopping()
+        else:
+            # iteration-batched segments (config.iter_batch): K
+            # iterations per device dispatch, eval/flush only at
+            # segment boundaries — bit-parity with the K=1 loop
+            stop, k = gbdt.train_segment(num_boost_round - done,
+                                         is_eval=is_eval)
+            done += k
     return booster

@@ -207,12 +207,21 @@ class Application:
         log.info("Started training...")
         start = time.time()
         is_finished = False
-        for it in range(cfg.num_iterations):
-            if is_finished:
-                break
-            is_finished = self.boosting.train_one_iter(None, None, True)
-            log.info("%f seconds elapsed, finished iteration %d"
-                     % (time.time() - start, it + 1))
+        it = 0
+        # iteration-batched segments (config.iter_batch): the booster
+        # scans K iterations per device dispatch and surfaces control
+        # only at metric / early-stop / re-bagging boundaries.  Metric
+        # lines and the final model are identical to the per-iteration
+        # loop's; the incremental-save cadence and the elapsed-seconds
+        # log timestamps become per-SEGMENT (up to K iterations between
+        # appends — iter_batch=1 restores the per-iteration cadence)
+        while it < cfg.num_iterations and not is_finished:
+            is_finished, done = self.boosting.train_segment(
+                cfg.num_iterations - it)
+            for j in range(done):
+                log.info("%f seconds elapsed, finished iteration %d"
+                         % (time.time() - start, it + j + 1))
+            it += done
             self.boosting.save_model_to_file(NO_LIMIT, is_finished,
                                              cfg.output_model)
         self.boosting.save_model_to_file(NO_LIMIT, True, cfg.output_model)

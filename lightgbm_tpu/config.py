@@ -204,6 +204,20 @@ class Config:
     #                                       hist_dtype=float32 (the f64 parity
     #                                       configuration keeps the masked
     #                                       full-sweep oracle)
+    iter_batch: str = "auto"              # auto | N | 1: boosting iterations
+    #                                       scanned per device dispatch
+    #                                       (models/gbdt.py train_segment).
+    #                                       Segments end at every metric /
+    #                                       early-stop / re-bagging / re-sort
+    #                                       boundary, so observable behavior
+    #                                       is unchanged and K>1 is bit-parity
+    #                                       with the per-iteration oracle
+    #                                       (iter_batch=1); auto picks a K
+    #                                       that divides metric_freq on
+    #                                       accelerators and 1 on CPU (local
+    #                                       dispatch is cheap; the K-scan
+    #                                       exists to kill remote-attached
+    #                                       dispatch round-trips)
     donate_buffers: bool = True
     device_type: str = ""                 # "" = default JAX platform | cpu | tpu
 
@@ -355,6 +369,7 @@ class Config:
         set_str("hist_ordered")
         set_int("hist_reorder_every")
         set_str("bag_compact")
+        set_str("iter_batch")
         set_bool("donate_buffers")
         set_str("device_type")
         set_str("serve_host")
@@ -390,6 +405,14 @@ class Config:
         if c.bag_compact not in ("auto", "on", "off"):
             log.fatal("Unknown bag_compact %s (expect auto|on|off)"
                       % c.bag_compact)
+        if c.iter_batch != "auto":
+            try:
+                ib = int(c.iter_batch)
+            except ValueError:
+                ib = 0
+            if ib < 1:
+                log.fatal("iter_batch must be 'auto' or an integer >= 1 "
+                          "(got %s)" % c.iter_batch)
         if c.hist_dtype not in ("float32", "float64"):
             log.fatal("Unknown hist_dtype %s (expect float32|float64)"
                       % c.hist_dtype)

@@ -15,7 +15,7 @@ parity tests with bagging enabled.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +122,82 @@ def _permute_packed_bag(packed: jax.Array, row_order: jax.Array):
     return jnp.take(_unpack_bag(packed, row_order.shape[0]), row_order)
 
 
+# -- iteration batching (config.iter_batch) ---------------------------------
+#
+# One GENERIC wrapper turns any fused step body into a K-iteration body:
+# an outer lax.scan whose carry is the cross-iteration device state
+# (scores, valid scores, the stopped flag, and — on the reorder variants —
+# bins/bag/gstate/row order), whose xs are the per-iteration HOST inputs
+# (feature masks; DART adds drop lists, shrinkage factors and bank rows),
+# and whose ys are the K packed trees, stacked [K, T_ints]/[K, T_floats]
+# and pulled host-side in one transfer by the usual deferred flush.  The
+# wrapped body keeps the original positional signature, so the jit/
+# shard_map plumbing (donation positions, partition specs) is untouched —
+# replicated specs (P()) hold for the [K, ...] xs/ys regardless of rank.
+#
+# A spec is (carry (in_pos, out_pos) pairs, xs in positions, ys out
+# positions, output arity); everything else is segment-constant and stays
+# closed over via the outer args.
+
+_SCAN_PLAIN = (((0, 0), (1, 1), (7, 4)), (3,), (2, 3), 5)
+_SCAN_REORDER = (((0, 0), (1, 1), (2, 5), (4, 4), (6, 6), (7, 7), (8, 8)),
+                 (3,), (2, 3), 9)
+_SCAN_MULTI = (((0, 0), (1, 1), (7, 4)), (3,), (2, 3), 5)
+_SCAN_MULTI_REORDER = (((0, 0), (1, 1), (2, 6), (4, 5), (6, 7), (7, 4),
+                        (8, 8)), (3,), (2, 3), 9)
+_SCAN_DART = (((0, 0), (1, 1), (2, 2), (3, 3), (4, 4), (5, 5), (15, 8)),
+              (6, 7, 8, 9, 11, 16), (6, 7), 9)
+
+
+def _batch_iters(body, spec, k):
+    """Wrap a fused step body in an outer lax.scan over `k` boosting
+    iterations.  k == 1 returns the body unchanged — the per-iteration
+    oracle executes the very same closure, so K>1 is bit-parity with it
+    by construction (same ops, same order, iterated by the scan)."""
+    if k <= 1:
+        return body
+    carry_map, xs_pos, ys_pos, n_out = spec
+
+    def batched(*args):
+        carry0 = tuple(args[i] for i, _ in carry_map)
+        xs = tuple(args[i] for i in xs_pos)
+
+        def scan_body(carry, x):
+            call = list(args)
+            for (i, _), v in zip(carry_map, carry):
+                call[i] = v
+            for i, v in zip(xs_pos, x):
+                call[i] = v
+            outs = body(*call)
+            return (tuple(outs[o] for _, o in carry_map),
+                    tuple(outs[o] for o in ys_pos))
+
+        carry, ys = jax.lax.scan(scan_body, carry0, xs)
+        out = [None] * n_out
+        for (_, o), v in zip(carry_map, carry):
+            out[o] = v
+        for o, v in zip(ys_pos, ys):
+            out[o] = v
+        return tuple(out)
+    return batched
+
+
+# Device-dispatch accounting for bench.py (dispatches_per_tree): every
+# training-path executable invocation notes itself here.  A host counter,
+# not a guard — analysis/guards.py counts the transfers.
+_DISPATCHES = 0
+
+
+def _note_dispatch() -> None:
+    global _DISPATCHES
+    _DISPATCHES += 1
+
+
+def dispatch_count() -> int:
+    """Total training-path device dispatches this process has issued."""
+    return _DISPATCHES
+
+
 def _fused_step_body(grad_fn, grow_kw, lr, dtype, compact_rows=0):
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
              gstate, stopped):
@@ -151,10 +227,12 @@ def _fused_step_body(grad_fn, grow_kw, lr, dtype, compact_rows=0):
     return step
 
 
-def _make_fused_step(grad_fn, grow_kw, lr, dtype, compact_rows=0):
-    return jax.jit(_fused_step_body(grad_fn, grow_kw, lr, dtype,
-                                    compact_rows),
-                   donate_argnums=(0, 1))
+def _make_fused_step(grad_fn, grow_kw, lr, dtype, compact_rows=0,
+                     k_iters=1):
+    body = _batch_iters(_fused_step_body(grad_fn, grow_kw, lr, dtype,
+                                         compact_rows),
+                        _SCAN_PLAIN, k_iters)
+    return jax.jit(body, donate_argnums=(0, 1))
 
 
 def _permute_window_rows(rel_w, m, n, bufs):
@@ -238,12 +316,15 @@ def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
 
 
 def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype,
-                             permute_state=None, compact_rows=0):
+                             permute_state=None, compact_rows=0,
+                             k_iters=1):
     # gstate is NOT donated: on the first re-sort it aliases the
     # objective's own arrays, which must stay valid for metrics/restarts
-    return jax.jit(_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
-                                            permute_state, compact_rows),
-                   donate_argnums=(0, 1, 2, 4, 7))
+    body = _batch_iters(_fused_step_body_reorder(grad_fn, grow_kw, lr,
+                                                 dtype, permute_state,
+                                                 compact_rows),
+                        _SCAN_REORDER, k_iters)
+    return jax.jit(body, donate_argnums=(0, 1, 2, 4, 7))
 
 
 def _dart_layout(L):
@@ -260,7 +341,7 @@ def _dart_layout(L):
 
 
 def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves,
-                          compact_rows=0):
+                          compact_rows=0, k_iters=1):
     """Fused DART iteration over a DEVICE-RESIDENT tree bank (VERDICT r3
     weak #5: DART previously paid ~6 host dispatches + a blocking tree
     flush per iteration for its drop/normalize score surgery).  The bank
@@ -382,7 +463,8 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves,
         # pristine values for the f64 factor replay, with no bank pull
         return (scores, list(vss), bank_i, bank_f, leaf_bank,
                 list(new_vbanks), ints, floats, stopped)
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+    return jax.jit(_batch_iters(step, _SCAN_DART, k_iters),
+                   donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
 def _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder=False,
@@ -482,19 +564,22 @@ def _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder=False,
 
 
 def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False,
-                           permute_state=None, compact_rows=0):
+                           permute_state=None, compact_rows=0, k_iters=1):
     # gstate is NOT donated: on the first re-sort it aliases the
     # objective's own arrays (same constraint as the single-class
     # reorder step)
-    return jax.jit(_fused_step_multi_body(grad_fn, grow_kw, lr, dtype,
-                                          reorder, permute_state,
-                                          compact_rows),
+    body = _batch_iters(
+        _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder,
+                               permute_state, compact_rows),
+        _SCAN_MULTI_REORDER if reorder else _SCAN_MULTI, k_iters)
+    return jax.jit(body,
                    donate_argnums=(0, 1, 2, 4, 8) if reorder else (0, 1))
 
 
 def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
                                    n_valid, gstate_specs, reorder,
-                                   permute_state=None, compact_rows=0):
+                                   permute_state=None, compact_rows=0,
+                                   k_iters=1):
     """The multiclass fused step under shard_map for single-host
     tree_learner=data (VERDICT r4 #3): the class-wise scan body already
     threads psum_axis through grow_kw, so sharding it is the same
@@ -506,8 +591,13 @@ def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    body = _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder,
-                                  permute_state, compact_rows)
+    # the scan wraps the BODY, inside shard_map: each shard iterates its
+    # rows through the K steps, collectives stay per-step, and the
+    # replicated specs (P()) cover the [K, ...] xs/ys at any rank
+    body = _batch_iters(
+        _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder,
+                               permute_state, compact_rows),
+        _SCAN_MULTI_REORDER if reorder else _SCAN_MULTI, k_iters)
     row = P(DATA_AXIS)
     row2 = P(None, DATA_AXIS)
     rep = P()
@@ -530,7 +620,8 @@ def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
 
 def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
                              n_valid, gstate_specs, reorder,
-                             permute_state=None, compact_rows=0):
+                             permute_state=None, compact_rows=0,
+                             k_iters=1):
     """The fused step under shard_map for single-host tree_learner=data
     (VERDICT r3 #2): per-row state (scores row, bins, bag mask, gradient
     state, row order) shards along the data axis, valid sets and tree
@@ -545,11 +636,14 @@ def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
 
     from ..parallel.mesh import DATA_AXIS, shard_map
 
-    body = (_fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
-                                     permute_state, compact_rows)
+    body = (_batch_iters(_fused_step_body_reorder(grad_fn, grow_kw, lr,
+                                                  dtype, permute_state,
+                                                  compact_rows),
+                         _SCAN_REORDER, k_iters)
             if reorder
-            else _fused_step_body(grad_fn, grow_kw, lr, dtype,
-                                  compact_rows))
+            else _batch_iters(_fused_step_body(grad_fn, grow_kw, lr,
+                                               dtype, compact_rows),
+                              _SCAN_PLAIN, k_iters))
     row = P(DATA_AXIS)
     row2 = P(None, DATA_AXIS)
     rep = P()
@@ -1049,7 +1143,7 @@ class GBDT:
             fmask = self._feature_mask(0)
             fmask_dev = (self.grower.replicate(fmask) if self._mh_fused
                          else jnp.asarray(fmask))
-            self._models.append(self._run_fused(
+            self._models.extend(self._run_fused(
                 self._bag_mask_dev_fused(0), fmask_dev))
         elif gradients is None and self._can_fuse_multi():
             # multiclass fused iteration: all K per-iteration trees in
@@ -1190,31 +1284,35 @@ class GBDT:
             self._bag_stacked = m
         return self._bag_stacked
 
-    def _run_fused_multi(self):
+    def _run_fused_multi(self, k_iters: int = 1):
         cfg = self.config
         lr = self.shrinkage_rate
-        for cls in range(self.num_class):
-            self._bagging(self.iter, cls)
-        self._ensure_bag_arranged()
-        fmasks = np.stack([self._feature_mask(c)
-                           for c in range(self.num_class)])
+        # per-iteration host draws in the exact sequential order: class-
+        # wise bagging (a no-op past the segment's first iteration — the
+        # scheduler ends segments at re-bag boundaries), then the K
+        # per-class feature masks
+        fmasks_list = []
+        for j in range(k_iters):
+            for cls in range(self.num_class):
+                self._bagging(self.iter + j, cls)
+            if j == 0:
+                self._ensure_bag_arranged()
+            fmasks_list.append(np.stack([self._feature_mask(c)
+                                         for c in range(self.num_class)]))
+        fmasks = (fmasks_list[0] if k_iters == 1
+                  else np.stack(fmasks_list))
         # shared-joint-order ordered-partition growth (round 4): same
         # gate and cadence as the single-class reorder — re-sort after
         # the first iteration, then every reorder_every (hist_ranged
         # already requires serial or the fused sharded learner)
-        ordered_on = (self.hist_ranged
-                      and getattr(self.objective, "row_permutable", False))
-        reorder = (ordered_on
-                   and self._trees_since_reorder
-                   >= (0 if self._row_order is None
-                       else self.reorder_every - 1))
+        reorder = self._reorder_now_multi()
         compact = self._bag_compact_rows() if self._bag_arranged else 0
         gstate = self._gstate_for_fused()
         key = ("multi", self.objective.fused_key(), lr, self.dtype,
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
-               reorder, compact,
+               reorder, compact, k_iters,
                (cfg.hist_agg, self.grower.num_shards,
                 id(self.grower.mesh)) if self.grower is not None else None)
 
@@ -1234,14 +1332,15 @@ class GBDT:
                     self.dtype, self.grower.mesh,
                     len(self.valid_bins_dev),
                     self._fused_gspecs(gstate), reorder,
-                    self.objective.make_permute_fn(), compact)
+                    self.objective.make_permute_fn(), compact, k_iters)
             return _make_fused_step_multi(self.objective.make_grad_fn(),
                                           grow_kw, lr, self.dtype,
                                           reorder,
                                           self.objective.make_permute_fn(),
-                                          compact)
+                                          compact, k_iters)
 
         fn = _get_fused_step(key, make)
+        _note_dispatch()
         fmasks_dev = (self.grower.replicate(fmasks) if self._mh_fused
                       else jnp.asarray(fmasks))
         common = (self.scores, list(self.valid_scores),
@@ -1259,13 +1358,16 @@ class GBDT:
         else:
             (scores, valid, ints_k, floats_k,
              self._dev_stopped) = fn(*common)
-            self._trees_since_reorder += 1
+            self._trees_since_reorder += k_iters
         self.scores = scores
         self.valid_scores = list(valid)
         # device row slices stay unmaterialized: _flush_pending stacks
         # and pulls every pending tree in ONE transfer
-        return [_PendingTree(ints_k[c], floats_k[c], lr, gated=True)
-                for c in range(self.num_class)]
+        if k_iters == 1:
+            return [_PendingTree(ints_k[c], floats_k[c], lr, gated=True)
+                    for c in range(self.num_class)]
+        return [_PendingTree(ints_k[j, c], floats_k[j, c], lr, gated=True)
+                for j in range(k_iters) for c in range(self.num_class)]
 
     def _gstate_for_fused(self):
         """Gradient state for the fused dispatch: the cached permuted/
@@ -1321,6 +1423,146 @@ class GBDT:
         return (self.hist_ranged
                 and getattr(self.objective, "row_permutable", False)
                 and self._can_fuse())
+
+    def _reorder_due(self) -> bool:
+        """Does the NEXT iteration hit the re-sort cadence?  (First tree
+        re-sorts — clustering pays from tree 2 on — then every
+        reorder_every trees.)"""
+        return (self._trees_since_reorder
+                >= (0 if self._row_order is None
+                    else self.reorder_every - 1))
+
+    def _reorder_now(self) -> bool:
+        return self._reorder_enabled() and self._reorder_due()
+
+    def _ordered_on_multi(self) -> bool:
+        """The multiclass ordered-partition gate (shared by the segment
+        scheduler and the dispatch so they can never disagree on which
+        body variant a segment runs)."""
+        return (self.hist_ranged
+                and getattr(self.objective, "row_permutable", False))
+
+    def _reorder_now_multi(self) -> bool:
+        return self._ordered_on_multi() and self._reorder_due()
+
+    # -- iteration batching (config.iter_batch): segment scheduling ----
+    def _iter_batch_k(self) -> int:
+        """The configured dispatch batch K (1 = per-iteration oracle)."""
+        v = self.config.iter_batch
+        if v == "auto":
+            return self._auto_iter_batch()
+        return max(int(v), 1)
+
+    _ITER_BATCH_AUTO = 8
+
+    def _auto_iter_batch(self) -> int:
+        """auto K: the default batch on ACCELERATORS, shrunk to the
+        largest divisor of metric_freq when metric output is live so
+        segments tile the metric grid with ONE executable instead of an
+        alternating pair.  On the CPU backend auto resolves to 1: local
+        dispatch costs microseconds — batching only removes the
+        host<->device round-trips of remote-attached accelerators — and
+        the K-scan's extra XLA CPU compile time buys nothing (explicit
+        iter_batch=N still forces batching anywhere)."""
+        if jax.devices()[0].platform == "cpu":
+            return 1
+        return self._auto_iter_batch_accel()
+
+    def _auto_iter_batch_accel(self) -> int:
+        k = self._ITER_BATCH_AUTO
+        if self._metrics_active():
+            mf = max(int(self.config.metric_freq), 1)
+            k = min(k, mf)
+            while mf % k:
+                k -= 1
+        return k
+
+    def _metrics_active(self) -> bool:
+        return (bool(self.training_metrics)
+                or any(len(ms) > 0 for ms in self.valid_metrics))
+
+    def _segment_fusible(self) -> bool:
+        """Paths the batched dispatch covers (the general per-tree path
+        keeps K=1: its per-iteration grad round-trip is the thing the
+        fused steps already removed)."""
+        return self._can_fuse() or self._can_fuse_multi()
+
+    def _plan_segment(self, max_iters: int, is_eval: bool) -> int:
+        """K for the next dispatch: min(iter_batch, metric boundary,
+        early-stop check, re-bagging epoch boundary, re-sort cadence,
+        remaining iterations) — every host-observable boundary ends a
+        segment, so batched training is bit-parity with the K=1 oracle
+        including the exact metric lines, early-stop iteration, bagging
+        epochs and checkpoints."""
+        k = min(self._iter_batch_k(), max_iters)
+        if k <= 1 or self._stopped or not self._segment_fusible():
+            return 1
+        if is_eval:
+            if self.early_stopping_round > 0:
+                # the reference checks early stopping every iteration;
+                # batching would skip checks, so the oracle cadence wins
+                return 1
+            if self._metrics_active():
+                mf = max(int(self.config.metric_freq), 1)
+                k = min(k, mf - self.iter % mf)
+        if self.bagging_enabled:
+            freq = max(int(self.config.bagging_freq), 1)
+            # iteration `it` re-bags when it % freq == 0; the segment
+            # may start ON a boundary but not cross the next one
+            k = min(k, freq - self.iter % freq)
+        ordered_on = (self._reorder_enabled() if self.num_class == 1
+                      else self._ordered_on_multi())
+        if ordered_on:
+            if self.reorder_every > 1:
+                if self._reorder_due():
+                    return 1     # the re-sort dispatch runs alone
+                k = min(k, self.reorder_every - 1
+                        - self._trees_since_reorder)
+            # reorder_every == 1: every iteration re-sorts — the segment
+            # scans the reorder body uniformly, no cap needed
+        # (DART needs no extra cap: _ensure_bank_capacity grows the
+        # bank to fit any k before the dispatch)
+        return max(k, 1)
+
+    def train_segment(self, max_iters: int,
+                      is_eval: bool = True) -> "Tuple[bool, int]":
+        """Train up to max_iters boosting iterations, batching
+        K = _plan_segment of them into ONE device dispatch; host work
+        (metric lines, early stopping, flushes, re-bagging draws) runs
+        only at segment boundaries, exactly where the K=1 loop would
+        have run it.  Returns (stop, iterations_done)."""
+        k = self._plan_segment(max_iters, is_eval)
+        if k <= 1:
+            return self.train_one_iter(None, None, is_eval), 1
+        it0 = self.iter
+        self._train_segment_fused(k)
+        self.iter += k
+        self.num_used_model = len(self._models) // self.num_class
+        if is_eval:
+            return self.eval_and_check_early_stopping(), k
+        if it0 // self._flush_every != self.iter // self._flush_every:
+            # the segment crossed a deferred-flush boundary: same
+            # amortized device->host pull cadence as the K=1 loop
+            if self._sync_stop(self._flush_pending()):
+                log.info("Stopped training because there are no more "
+                         "leafs that meet the split requirements.")
+                return True, k
+        return False, k
+
+    def _train_segment_fused(self, k: int) -> None:
+        """Dispatch one K-iteration segment and append the pending trees
+        (DART overrides with its banked variant)."""
+        if self._can_fuse():
+            self._ensure_layout()
+            self._bagging(self.iter, 0)
+            self._ensure_bag_arranged()
+            fmasks = np.stack([self._feature_mask(0) for _ in range(k)])
+            fmasks_dev = (self.grower.replicate(fmasks) if self._mh_fused
+                          else jnp.asarray(fmasks))
+            self._models.extend(self._run_fused(
+                self._bag_mask_dev_fused(0), fmasks_dev, k))
+        else:
+            self._models.extend(self._run_fused_multi(k))
 
     def _bag_mask_dev_fused(self, cls: int):
         """Fused-path bag mask: bit-packed file-order upload normally;
@@ -1503,6 +1745,7 @@ class GBDT:
                                      bank is not None)
 
         fn = _get_fused_step(key, make)
+        _note_dispatch()
         args = (self.bins_dev, self.scores, mask, gstate, order)
         if bank is not None:
             args += (bank,)
@@ -1519,15 +1762,20 @@ class GBDT:
         self._row_order = order_new
         self._inv_order = None
 
-    def _run_fused(self, bag_mask_dev, fmask_dev) -> "_PendingTree":
+    def _run_fused(self, bag_mask_dev, fmask_dev,
+                   k_iters: int = 1) -> "List[_PendingTree]":
+        """One fused dispatch covering k_iters boosting iterations
+        (config.iter_batch; k_iters=1 is the per-iteration oracle).
+        fmask_dev is [F] for k_iters=1 and [K, F] stacked otherwise;
+        packed trees come back stacked and stay device-resident until
+        the next flush."""
         cfg = self.config
         lr = self.shrinkage_rate
         # re-sort after the FIRST tree (clustering pays from tree 2 on),
-        # then every reorder_every trees
-        reorder = (self._reorder_enabled()
-                   and self._trees_since_reorder
-                   >= (0 if self._row_order is None
-                       else self.reorder_every - 1))
+        # then every reorder_every trees.  Segments with k_iters > 1 are
+        # scheduled body-uniform (_plan_segment): either every iteration
+        # re-sorts (reorder_every == 1) or none does.
+        reorder = self._reorder_now()
         # bag compaction: the static window is live only while the
         # device state is actually arranged in-bag-first (the masked
         # full-sweep executable serves every other dispatch)
@@ -1537,7 +1785,7 @@ class GBDT:
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
-               reorder, compact,
+               reorder, compact, k_iters,
                # sharded steps close over the mesh and the aggregation
                # protocol — two data-parallel configs that differ only
                # here MUST NOT share an executable
@@ -1560,16 +1808,18 @@ class GBDT:
                     self.dtype, self.grower.mesh,
                     len(self.valid_bins_dev),
                     self._fused_gspecs(gstate), reorder,
-                    self.objective.make_permute_fn(), compact)
+                    self.objective.make_permute_fn(), compact, k_iters)
             if reorder:
                 return _make_fused_step_reorder(
                     self.objective.make_grad_fn(), grow_kw, lr,
                     self.dtype, self.objective.make_permute_fn(),
-                    compact)
+                    compact, k_iters)
             return _make_fused_step(self.objective.make_grad_fn(),
-                                    grow_kw, lr, self.dtype, compact)
+                                    grow_kw, lr, self.dtype, compact,
+                                    k_iters)
 
         fn = _get_fused_step(key, make)
+        _note_dispatch()
         if reorder:
             # the reorder executable must see ONE bag-mask signature:
             # dispatches under an active row order pass the cached
@@ -1598,13 +1848,20 @@ class GBDT:
                 self.scores, list(self.valid_scores), bag_mask_dev,
                 fmask_dev, self.bins_dev, tuple(self.valid_bins_dev),
                 gstate, self._dev_stopped)
-            self._trees_since_reorder += 1
+            self._trees_since_reorder += k_iters
         self.scores = scores
         self.valid_scores = list(valid)
-        return _PendingTree(ints, floats, lr, gated=True)
+        if k_iters == 1:
+            return [_PendingTree(ints, floats, lr, gated=True)]
+        # stacked [K, ...] rows stay unmaterialized device slices; the
+        # deferred flush stacks every pending tree and pulls them in one
+        # device_get
+        return [_PendingTree(ints[j], floats[j], lr, gated=True)
+                for j in range(k_iters)]
 
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
+        _note_dispatch()   # the general path: one grow dispatch per tree
         if self.grower is not None and self._mh:
             # assemble process-local grad/hess into global sharded arrays,
             # grow SPMD across hosts, then pull the tree (replicated) and
@@ -1705,8 +1962,12 @@ class GBDT:
             assert len({m.ints.shape for m in pend}) == 1 \
                 and len({m.floats.shape for m in pend}) == 1, \
                 "pending tree buffers must share one packed shape"
-            ints_all = np.asarray(jnp.stack([m.ints for m in pend]))
-            floats_all = np.asarray(jnp.stack([m.floats for m in pend]))
+            # explicit device_get: ONE counted transfer for the whole
+            # batch (analysis/guards.py device_get accounting — bench
+            # reports it as the per-tree sync metric)
+            ints_all, floats_all = jax.device_get(
+                (jnp.stack([m.ints for m in pend]),
+                 jnp.stack([m.floats for m in pend])))
             for m, ih, fh in zip(pend, ints_all, floats_all):
                 m.ints, m.floats = ih, fh
         stop_at = None
@@ -2675,6 +2936,15 @@ class DART(GBDT):
         # host-tree fallback keeps the masked oracle
         return self._can_fuse_dart()
 
+    def _segment_fusible(self) -> bool:
+        # iteration batching rides the banked path only (host-tree DART
+        # needs per-iteration score surgery on host trees)
+        return (self._can_fuse_dart()
+                and (self._bank is not None or not self._models))
+
+    def _train_segment_fused(self, k: int) -> None:
+        self._run_fused_dart(k)
+
     def _dart_bank_rows(self):
         """The leaf bank [T, n_pad] is per-row state: the in-bag-first
         arrangement must carry it (drop/normalize gathers read it by
@@ -2716,26 +2986,31 @@ class DART(GBDT):
             return self.eval_and_check_early_stopping()
         return False
 
-    def _draw_drops(self) -> None:
-        """The drop lottery (dart.hpp:86-99), shared verbatim by both
-        paths so the mt19937 stream stays golden-pinned."""
+    def _draw_drops(self, it: int) -> None:
+        """The drop lottery (dart.hpp:86-99) for iteration `it`, shared
+        verbatim by both paths so the mt19937 stream stays golden-pinned.
+        Pure host state (drop_rng position + `it`), so a K-iteration
+        segment precomputes all K lotteries before the dispatch."""
         self.drop_index = []
         if self.drop_rate > 1e-15:
-            if self.iter > 0:
-                draws = self.drop_rng.next_doubles(self.iter)
-                self.drop_index = [i for i in range(self.iter)
+            if it > 0:
+                draws = self.drop_rng.next_doubles(it)
+                self.drop_index = [i for i in range(it)
                                    if draws[i] < self.drop_rate]
-        if not self.drop_index and self.iter > 0:
-            self.drop_index = list(self.drop_rng.sample(self.iter, 1))
+        if not self.drop_index and it > 0:
+            self.drop_index = list(self.drop_rng.sample(it, 1))
         self.shrinkage_rate = 1.0 / (1.0 + len(self.drop_index))
 
-    def _run_fused_dart(self) -> None:
+    def _ensure_bank_capacity(self, k_iters: int) -> None:
+        """Bank rows for the next k_iters trees (+ the dummy row dead
+        steps write to); initializes on first use, doubles past
+        config.num_iterations (api num_boost_round, bench loops)."""
         cfg = self.config
         L = max(cfg.num_leaves, 2)
         SF0, TB0, LC0, RC0, RC1, LV0, LV1 = _dart_layout(L)
         leaf_dt = np.uint8 if L <= 256 else np.int32
         if self._bank is None:
-            T = cfg.num_iterations + 1      # + dummy row for dead steps
+            T = max(cfg.num_iterations, k_iters) + 1  # + dummy row
             li = 1 + 4 * (L - 1) + 3 * L
             lf = 3 * L - 2
             bi = np.zeros((T, li), np.int32)
@@ -2749,13 +3024,11 @@ class DART(GBDT):
                           [jnp.zeros((T, int(vb.shape[1])), dtype=leaf_dt)
                            for vb in self.valid_bins_dev]]
             self._bank_count = 0
-        elif self._bank_count >= self._bank[0].shape[0] - 1:
-            # callers may iterate past config.num_iterations (api
-            # num_boost_round, bench loops): double the bank, keeping
-            # new rows traversal-safe.  The OLD dummy row becomes a real
-            # row — reset it too: dead (post-stop) steps may have written
-            # a garbage tree there, which would otherwise materialize as
-            # a phantom model entry
+        while self._bank_count + k_iters > self._bank[0].shape[0] - 1:
+            # double the bank, keeping new rows traversal-safe.  The OLD
+            # dummy row becomes a real row — reset it too: dead
+            # (post-stop) steps may have written a garbage tree there,
+            # which would otherwise materialize as a phantom model entry
             T = self._bank[0].shape[0]
             safe = np.zeros((1, self._bank[0].shape[1]), np.int32)
             safe[:, LC0:RC1] = -1
@@ -2771,57 +3044,96 @@ class DART(GBDT):
                 dbl(self._bank[1].at[T - 1].set(0.0)),
                 dbl(self._bank[2]),
                 [dbl(vb) for vb in self._bank[3]]]
-        self._draw_drops()
-        k = len(self.drop_index)
-        # record this cycle's f64 factor pair against every dropped row
-        # (replayed at materialization; entries from iterations past a
-        # stump stop are filtered out there, matching the device gating)
-        for i in self.drop_index:
-            self._bank_hist.setdefault(i, []).append(
-                (self.iter, self.shrinkage_rate, float(k)))
-        # fixed cap -> ONE executable for every k <= 8 (padded slots are
-        # lax.cond-skipped); pow2 buckets beyond are the rare escape for
-        # high drop rates
-        dp = 8
-        while dp < k:
-            dp *= 2
-        drop_idx = np.zeros(dp, np.int32)
-        drop_idx[:k] = self.drop_index
-        drop_mask = np.zeros(dp, bool)
-        drop_mask[:k] = True
-        self._bagging(self.iter, 0)
-        self._ensure_bag_arranged()
+
+    def _run_fused_dart(self, k_iters: int = 1) -> None:
+        cfg = self.config
+        L = max(cfg.num_leaves, 2)
+        self._ensure_bank_capacity(k_iters)
+        # per-iteration host inputs, drawn in the exact sequential order
+        # (drop lottery -> bagging -> feature mask per iteration): drop
+        # lists, 1/(1+k) shrinkages and normalization factors are pure
+        # host/mt19937 state, so a K-segment precomputes them all and
+        # feeds them as stacked [K, ...] scan inputs
+        drops, rates, kfs, fmasks = [], [], [], []
+        for j in range(k_iters):
+            it = self.iter + j
+            self._draw_drops(it)
+            kd = len(self.drop_index)
+            # record this cycle's f64 factor pair against every dropped
+            # row (replayed at materialization; entries from iterations
+            # past a stump stop are filtered out there, matching the
+            # device gating)
+            for i in self.drop_index:
+                self._bank_hist.setdefault(i, []).append(
+                    (it, self.shrinkage_rate, float(kd)))
+            drops.append(list(self.drop_index))
+            rates.append(self.shrinkage_rate)
+            kfs.append(float(kd))
+            self._bagging(it, 0)
+            if j == 0:
+                self._ensure_bag_arranged()
+            fmasks.append(self._feature_mask(0))
         compact = self._bag_compact_rows() if self._bag_arranged else 0
-        fmask = self._feature_mask(0)
+        # fixed cap -> ONE executable for every drop count <= 8 (padded
+        # slots are lax.cond-skipped); pow2 buckets beyond are the rare
+        # escape for high drop rates.  A segment pads every iteration to
+        # its max bucket so the whole segment shares one executable.
+        dp = 8
+        while dp < max(len(d) for d in drops):
+            dp *= 2
+        drop_idx = np.zeros((k_iters, dp), np.int32)
+        drop_mask = np.zeros((k_iters, dp), bool)
+        for j, d in enumerate(drops):
+            drop_idx[j, :len(d)] = d
+            drop_mask[j, :len(d)] = True
         key = ("dart", self.objective.fused_key(), self.dtype,
                self.hist_impl, self.max_bin, L, cfg.max_depth,
                self.params, len(self.valid_bins_dev), self.hist_slots,
-               self.hist_compact, self.hist_ranged, dp, compact)
+               self.hist_compact, self.hist_ranged, dp, compact, k_iters)
 
         def make():
             grow_kw = self._grow_kw()
             return _make_fused_step_dart(self.objective.make_grad_fn(),
-                                         grow_kw, self.dtype, L, compact)
+                                         grow_kw, self.dtype, L, compact,
+                                         k_iters)
 
         fn = _get_fused_step(key, make)
+        _note_dispatch()
+        if k_iters == 1:
+            dev_in = (jnp.asarray(drop_idx[0]), jnp.asarray(drop_mask[0]),
+                      jnp.asarray(rates[0], dtype=self.dtype),
+                      jnp.asarray(kfs[0], dtype=self.dtype))
+            t_row = jnp.int32(self._bank_count)
+        else:
+            dev_in = (jnp.asarray(drop_idx), jnp.asarray(drop_mask),
+                      jnp.asarray(np.asarray(rates, dtype=np.float64)
+                                  .astype(self.dtype)),
+                      jnp.asarray(np.asarray(kfs, dtype=np.float64)
+                                  .astype(self.dtype)))
+            t_row = jnp.arange(self._bank_count,
+                               self._bank_count + k_iters,
+                               dtype=jnp.int32)
         (self.scores, valid, bi, bf, lb, vbs, ints, floats,
          self._dev_stopped) = fn(
             self.scores, list(self.valid_scores), self._bank[0],
             self._bank[1], self._bank[2], list(self._bank[3]),
-            jnp.asarray(drop_idx), jnp.asarray(drop_mask),
-            jnp.asarray(self.shrinkage_rate, dtype=self.dtype),
-            jnp.asarray(float(k), dtype=self.dtype),
-            self._bag_mask_dev_fused(0), jnp.asarray(fmask),
+            dev_in[0], dev_in[1], dev_in[2], dev_in[3],
+            self._bag_mask_dev_fused(0),
+            jnp.asarray(fmasks[0] if k_iters == 1 else np.stack(fmasks)),
             self.bins_dev, tuple(self.valid_bins_dev),
-            self._gstate_for_fused(), self._dev_stopped,
-            jnp.int32(self._bank_count))
+            self._gstate_for_fused(), self._dev_stopped, t_row)
         self._bank = [bi, bf, lb, list(vbs)]
         self.valid_scores = list(valid)
-        # raw floats + this iteration's 1/(1+k) shrinkage applied on the
+        # raw floats + each iteration's 1/(1+k) shrinkage applied on the
         # host in f64, like every other fused path
-        self._models.append(_PendingTree(ints, floats,
-                                         self.shrinkage_rate, gated=True))
-        self._bank_count += 1
+        if k_iters == 1:
+            self._models.append(_PendingTree(ints, floats, rates[0],
+                                             gated=True))
+        else:
+            self._models.extend(
+                _PendingTree(ints[j], floats[j], rates[j], gated=True)
+                for j in range(k_iters))
+        self._bank_count += k_iters
         self._bank_dirty = True
 
     def _materialize_bank(self) -> None:
@@ -2869,7 +3181,7 @@ class DART(GBDT):
     def _dropping_trees(self) -> None:
         """dart.hpp:86-110 on HOST trees (non-banked path): drop trees
         from the train score, set shrinkage."""
-        self._draw_drops()
+        self._draw_drops(self.iter)
         for i in self.drop_index:
             for cls in range(self.num_class):
                 t = self.models[i * self.num_class + cls]

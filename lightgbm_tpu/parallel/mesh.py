@@ -16,6 +16,18 @@ so tree arrays come out replicated and leaf_id stays shard-local.
 Multi-host scaling needs no extra code here: initialize
 jax.distributed and build the mesh over all devices; XLA routes the psum
 over ICI within a slice and DCN across slices.
+
+Iteration batching (config.iter_batch) composes with this design by
+putting its lax.scan INSIDE the shard_map body (models/gbdt.py
+_batch_iters wraps the step closure BEFORE it reaches shard_map below):
+each shard iterates its local rows through K boosting steps, the
+per-step psum/all-gather collectives are exactly the K=1 ones (issued
+K times inside the loop), and the stacked per-iteration inputs/outputs
+([K, F] feature masks in, [K, T_ints]/[K, T_floats] packed trees out)
+ride the replicated P() specs unchanged — P() constrains no axis, so
+the extra leading K dimension needs no new partition rules.  The
+check_vma/check_rep=False knob in the wrapper is what already permits
+replicated outputs from loop-carried computations.
 """
 
 from __future__ import annotations

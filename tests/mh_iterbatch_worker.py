@@ -1,0 +1,68 @@
+"""Worker for the multi-host iteration-batching test
+(test_iter_batch.py::test_multihost_batched_two_process).
+
+Usage: python mh_iterbatch_worker.py <rank> <nproc> <port> <data> <out>
+
+Each worker owns 4 virtual CPU devices, joins jax.distributed, loads
+its lottery row shard, and trains tree_learner=data through the
+MULTI-HOST fused sharded step twice: iter_batch=1 (the per-iteration
+oracle) and iter_batch=4 (K iterations scanned per dispatch, the scan
+INSIDE shard_map so per-step psums cross hosts exactly as before).
+Saves <out>_k1.txt / <out>_k4.txt and prints batched_segments=<0|1>
+for the K=4 run.
+"""
+
+import os
+import sys
+
+rank, nproc, port, data, out = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4], sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    # cross-process collectives on the CPU backend need the gloo
+    # implementation (without it the compiler rejects multiprocess
+    # computations outright on CPU-only boxes)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+ROUNDS = 6
+for ib in ("1", "4"):
+    cfg = Config.from_params({
+        "objective": "binary", "tree_learner": "data", "num_leaves": "8",
+        "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+        "hist_dtype": "float64", "metric": "", "iter_batch": ib,
+        "is_save_binary_file": "false"})
+    ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = create_boosting(cfg, ds, obj)
+    assert booster._mh_fused and booster._can_fuse(), \
+        "multi-host data-parallel must take the fused sharded path"
+    batched = 0
+    done = 0
+    while done < ROUNDS:
+        k = booster._plan_segment(ROUNDS - done, is_eval=False)
+        batched |= int(k > 1)
+        _, got = booster.train_segment(ROUNDS - done, is_eval=False)
+        done += got
+    if ib == "4":
+        print("batched_segments=%d" % batched)
+    booster.save_model_to_file(-1, True, "%s_k%s.txt" % (out, ib))
+print("worker %d done" % rank)

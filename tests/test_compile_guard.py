@@ -191,6 +191,98 @@ def test_fused_training_step_recompiles_only_for_new_config():
 
 
 # ---------------------------------------------------------------------------
+# iteration batching: one compile per (K, shape, config), zero recompiles
+# across segments of the same K and across re-bag boundaries under the
+# scan (the _get_fused_step key includes K — satellite)
+# ---------------------------------------------------------------------------
+
+def _batched_booster(extra=None, n=400):
+    from lightgbm_tpu.api import Dataset
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    rng_free = np.linspace(0.0, 1.0, n * 5)
+    x = np.sin(rng_free * 17.0).reshape(n, 5)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "min_sum_hessian_in_leaf": 1e-3,
+              "verbose": 0, **(extra or {})}
+    ds = Dataset(x, label=y, params=params)
+    cfg = Config.from_params({k: str(v) for k, v in params.items()})
+    obj = create_objective(cfg)
+    obj.init(ds.inner.metadata, ds.inner.num_data)
+    return create_boosting(cfg, ds.inner, obj)
+
+
+def _drive(booster, n):
+    done = 0
+    while done < n:
+        _, k = booster.train_segment(n - done, is_eval=False)
+        done += k
+
+
+def test_iter_batched_one_compile_per_k_no_retrace_across_segments():
+    """iter_batch=4 over 10 rounds segments as 4, 4, 2: the first K=4
+    and K=2 segments compile; the SECOND K=4 segment (and a whole
+    fresh same-config booster) must hit the cached executables — a
+    mid-run K change lands on a distinct cache entry instead of
+    retracing the shared one."""
+    import jax
+
+    a = _batched_booster({"iter_batch": 4, "num_iterations": 10})
+    _drive(a, 4)                      # compiles the K=4 executable
+    jax.block_until_ready(a.scores)
+    with compile_budget(0, what="second K=4 segment (same executable)"):
+        _drive(a, 4)
+        jax.block_until_ready(a.scores)
+    with track_compiles() as short_seg:
+        _drive(a, 2)                  # the K=2 final segment
+        jax.block_until_ready(a.scores)
+    assert short_seg.compiles > 0     # distinct entry for K=2
+    assert len(a.models) == 10        # flush materializes all 10 trees
+
+    b = _batched_booster({"iter_batch": 4, "num_iterations": 10})
+    with compile_budget(0, what="fresh same-config batched training"):
+        _drive(b, 10)
+        jax.block_until_ready(b.scores)
+
+
+def test_iter_batched_zero_recompiles_across_rebag_boundaries(
+        xla_guard):
+    """Re-bagging epochs under the scan: after one full warm cycle,
+    further segments crossing re-bag boundaries (mask redraw + packed
+    upload + batched fused steps) trigger ZERO compiles."""
+    import jax
+
+    g = _batched_booster({"iter_batch": 2, "bagging_fraction": 0.5,
+                          "bagging_freq": 2, "num_iterations": 12})
+    _drive(g, 4)                      # warm: two K=2 segments + re-bag
+    jax.block_until_ready(g.scores)
+    with xla_guard(0, what="batched segments across two re-bag "
+                           "boundaries"):
+        _drive(g, 6)                  # re-bags at 4, 6, 8
+        jax.block_until_ready(g.scores)
+
+
+def test_iter_batched_model_matches_oracle_bytes():
+    from lightgbm_tpu.api import Dataset, train
+
+    def text(k):
+        rng_free = np.linspace(0.0, 1.0, 240 * 5)
+        x = np.sin(rng_free * 17.0).reshape(240, 5)
+        y = (x.sum(axis=1) > 0).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": 7,
+                  "min_data_in_leaf": 5, "min_sum_hessian_in_leaf": 1e-3,
+                  "num_iterations": 6, "verbose": 0, "iter_batch": k}
+        b = train(params, Dataset(x, label=y, params=params),
+                  num_boost_round=6, verbose_eval=False)
+        return b.model_to_string()
+
+    assert text("4") == text("1")
+
+
+# ---------------------------------------------------------------------------
 # serving metrics lock-discipline regression (GL006 audit)
 # ---------------------------------------------------------------------------
 

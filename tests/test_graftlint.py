@@ -102,6 +102,9 @@ def test_fused_step_maker_closure_is_traced():
 
 
 def test_lax_scan_body_is_traced():
+    # scan bodies are traced; their host syncs classify as GL012 (the
+    # scan-carry sharpening of GL001) since the iteration-batched
+    # training loop landed
     out = lint("""
         import jax
 
@@ -110,7 +113,7 @@ def test_lax_scan_body_is_traced():
                 return c, x.item()
             return jax.lax.scan(body, 0, xs)
     """)
-    assert "GL001" in rules_of(out)
+    assert "GL012" in rules_of(out)
 
 
 # ---------------------------------------------------------------------------
@@ -586,6 +589,138 @@ bench-only probe; retrace per epoch is the point being measured
             return scores[:bag_rows]
     """)
     assert "GL011" not in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL012 host-sync-in-scan-carry
+# ---------------------------------------------------------------------------
+
+def test_gl012_item_on_scan_carry_flagged():
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, fmask):
+                carry = carry + fmask.sum()
+                _ = carry.item()
+                return carry, fmask
+            return jax.lax.scan(body, scores, xs)
+    """)
+    rules = rules_of(out)
+    assert "GL012" in rules and "GL001" not in rules
+
+
+def test_gl012_int_on_per_iteration_value_flagged():
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, x):
+                return carry, int(x)
+            return jax.lax.scan(body, scores, xs)
+    """)
+    assert "GL012" in rules_of(out)
+
+
+def test_gl012_device_get_in_scan_body_flagged():
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, x):
+                return carry, jax.device_get(x)
+            return jax.lax.scan(body, scores, xs)
+    """)
+    assert "GL012" in rules_of(out)
+
+
+def test_gl012_nested_helper_inside_scan_body_flagged():
+    out = lint("""
+        import jax
+        import numpy as np
+
+        def batched(scores, xs):
+            def body(carry, x):
+                def inner(v):
+                    return np.asarray(v)
+                return carry, inner(x)
+            return jax.lax.scan(body, scores, xs)
+    """)
+    assert "GL012" in rules_of(out)
+
+
+def test_gl012_clean_scan_body_not_flagged():
+    out = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def batched(scores, xs):
+            def body(carry, fmask):
+                return carry + jnp.sum(fmask), fmask
+            return jax.lax.scan(body, scores, xs)
+    """)
+    assert "GL012" not in rules_of(out)
+
+
+def test_gl012_same_named_def_outside_scan_scope_stays_gl001():
+    # two inner defs named `body` (the codebase's own inner-fn naming
+    # convention): only the one lexically visible to the lax.scan call
+    # is a scan body — the jitted sibling's sync stays GL001
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, x):
+                return carry + x, x
+            return jax.lax.scan(body, scores, xs)
+
+        def other(scores):
+            def body(x):
+                return x.sum().item()
+            return jax.jit(body)(scores)
+    """)
+    rules = rules_of(out)
+    assert "GL001" in rules and "GL012" not in rules
+
+
+def test_gl012_sync_outside_scan_stays_gl001():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def step(scores):
+            return scores.sum().item()
+    """)
+    rules = rules_of(out)
+    assert "GL001" in rules and "GL012" not in rules
+
+
+def test_gl012_bag_count_inside_scan_still_gl011():
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, x):
+                bag_rows = carry.sum()
+                return carry, int(bag_rows)
+            return jax.lax.scan(body, scores, xs)
+    """)
+    rules = rules_of(out)
+    assert "GL011" in rules and "GL012" not in rules
+
+
+def test_gl012_suppressible_with_justification():
+    out = lint("""
+        import jax
+
+        def batched(scores, xs):
+            def body(carry, x):
+                # graftlint: disable=GL012 -- debug probe kept behind an
+                # env flag; never runs in the batched training loop
+                return carry, x.item()
+            return jax.lax.scan(body, scores, xs)
+    """)
+    assert "GL012" not in rules_of(out)
 
 
 # ---------------------------------------------------------------------------
