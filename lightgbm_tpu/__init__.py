@@ -21,6 +21,8 @@ modules that actually trace jits (ops/*, objectives) before their first
 compile.
 """
 
+__jax_free__ = True
+
 __version__ = "0.3.0"
 
 _EXPORTS = {

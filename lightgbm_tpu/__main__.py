@@ -1,4 +1,6 @@
 """CLI entry: python -m lightgbm_tpu key=value ..."""
+
+__jax_free__ = True
 import sys
 
 from .cli import main

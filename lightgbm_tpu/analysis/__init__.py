@@ -16,29 +16,53 @@ the wrong line, and then they regress silently:
 
 This package machine-checks them:
 
-  graftlint.py  AST linter (`python -m lightgbm_tpu.analysis`), ~10
-                project-specific rules with verified inline
-                suppressions.  Pure stdlib — runs without jax.
+  graftlint.py  AST linter (`python -m lightgbm_tpu.analysis`), ~12
+                per-module rules with verified inline suppressions.
+                Pure stdlib — runs without jax.
+  contracts.py  the contract registry: invariants DECLARED at the
+                definition site (@contract.traced_pure, .parity_oracle,
+                .jax_free, .locked_by, .fused_body, .counted_flush and
+                the `__jax_free__` module marker), zero-cost at runtime.
+  callgraph.py  package-wide symbol table + call graph: module/import
+                resolution, method binding, closures, factories.
+  graftcheck.py whole-program contract analysis (rules GC001-GC007):
+                taint/effect propagation ACROSS calls — a host sync
+                three helpers below a traced entry point, a transitive
+                jax import two hops below a jax-free module, a serving
+                mutator reachable from an unlocked public method.
+  mutations.py  seeded-violation corpus: deliberate contract breaks
+                applied as source transforms to copies of the real
+                modules, proving every rule catches its bug class
+                (tests/test_graftcheck_mutations.py).
   typegate.py   annotation-completeness gate for the mypy-strict
-                modules (config.py, api.py, serving/) so the typing
-                bar holds even on machines without mypy.
+                modules (config.py, api.py, serving/, analysis/) so
+                the typing bar holds even on machines without mypy.
   guards.py     runtime counters: XLA compile + explicit-transfer
                 accounting as a context manager and pytest fixture,
                 so tests can assert "zero recompiles" budgets.
 
 See README.md "Static analysis & invariants" for the rule table and
-the suppression syntax.
+suppression syntax, and CONTRACTS.md for the contract registry.
 """
 
-__all__ = ["run_graftlint", "run_typegate", "compile_budget",
-           "track_compiles", "GuardViolation"]
+__jax_free__ = True
+
+__all__ = ["run_graftlint", "run_graftcheck", "run_typegate", "contract",
+           "compile_budget", "track_compiles", "GuardViolation"]
 
 
-def __getattr__(name):  # PEP 562: keep `import lightgbm_tpu.analysis` light
-    if name in ("run_graftlint",):
+def __getattr__(name: str) -> object:
+    # PEP 562: keep `import lightgbm_tpu.analysis` light
+    if name == "run_graftlint":
         from .graftlint import run_graftlint
         return run_graftlint
-    if name in ("run_typegate",):
+    if name == "run_graftcheck":
+        from .graftcheck import run_graftcheck
+        return run_graftcheck
+    if name == "contract":
+        from .contracts import contract
+        return contract
+    if name == "run_typegate":
         from .typegate import run_typegate
         return run_typegate
     if name in ("compile_budget", "track_compiles", "GuardViolation"):

@@ -1,35 +1,92 @@
-"""`python -m lightgbm_tpu.analysis` — run graftlint + the typing gate.
+"""`python -m lightgbm_tpu.analysis` — graftlint + graftcheck + typegate.
 
-Exit codes (scripts/lint.sh and CI gate on these):
+Exit codes (scripts/lint.sh, scripts/check.sh and CI gate on these):
   0  clean
-  1  findings (lint violations, bad/stale suppressions, typing gaps)
+  1  findings (lint violations, contract violations, bad/stale
+     suppressions, typing gaps) not covered by the baseline
   2  usage / internal error
 
 Options:
   --list-rules     print the rule table and exit
-  --no-typegate    graftlint only
-  --json           machine-readable findings (one object per line)
-  [paths...]       specific files (default: the whole package)
+  --no-typegate    skip the typing gate
+  --no-graftcheck  skip the whole-program contract analysis
+  --json           machine-readable findings (one object per line:
+                   {"path", "line", "rule", "message"})
+  --baseline FILE  suppress findings recorded in FILE (a JSON list of
+                   {"path", "rule", "message"} objects — line numbers
+                   deliberately ignored so unrelated edits don't
+                   un-baseline old findings); only NEW findings fail
+                   the run.  analysis/baseline.json is the checked-in
+                   baseline scripts/lint.sh uses, kept EMPTY while the
+                   tree is clean.
+  [paths...]       specific files (graftlint/typegate scope to them;
+                   graftcheck always analyzes the whole program — the
+                   rules are interprocedural — and reports findings
+                   for the given modules only)
 """
 
 from __future__ import annotations
 
-import json
-import sys
-from typing import List, Optional
+__jax_free__ = True
 
-from .graftlint import RULES, Finding, run_graftlint
+import json
+import os
+import sys
+from typing import List, Optional, Set, Tuple
+
+from .graftlint import RULES, Finding, package_root, run_graftlint
 from .typegate import gated_modules, run_typegate
+
+
+def _norm_path(path: str) -> str:
+    """Finding path -> package-relative path for baseline matching.
+    graftlint emits cwd-relative filesystem paths while graftcheck
+    emits package-relative ones; normalizing both to the part after
+    the last '<pkg>/' segment makes baseline entries independent of
+    the cwd and install location."""
+    p = path.replace(os.sep, "/")
+    marker = os.path.basename(package_root()) + "/"
+    idx = p.rfind(marker)
+    if idx >= 0:
+        return p[idx + len(marker):]
+    return p
+
+
+def _load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    with open(path, "r", encoding="utf-8") as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError("baseline must be a JSON list")
+    out: Set[Tuple[str, str, str]] = set()
+    for e in entries:
+        out.add((_norm_path(str(e["path"])), str(e["rule"]),
+                 str(e["message"])))
+    return out
+
+
+def _rel_to_package(path: str) -> str:
+    """CLI path argument -> package-relative module path (for scoping
+    graftcheck findings)."""
+    root = package_root()
+    return os.path.relpath(os.path.abspath(path), root).replace(
+        os.sep, "/")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     as_json = False
     typegate = True
+    graftcheck = True
+    baseline_path: Optional[str] = None
     paths: List[str] = []
-    for arg in argv:
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
         if arg == "--list-rules":
+            from .graftcheck import CHECK_RULES
             for rid, name in sorted(RULES.items()):
+                print("%s  %s" % (rid, name))
+            for rid, name in sorted(CHECK_RULES.items()):
                 print("%s  %s" % (rid, name))
             print("TYPE   annotation-completeness on: %s"
                   % ", ".join(gated_modules()))
@@ -38,30 +95,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             as_json = True
         elif arg == "--no-typegate":
             typegate = False
+        elif arg == "--no-graftcheck":
+            graftcheck = False
+        elif arg == "--baseline":
+            if i + 1 >= len(argv):
+                print("--baseline needs a file argument", file=sys.stderr)
+                return 2
+            i += 1
+            baseline_path = argv[i]
         elif arg.startswith("-"):
             print("unknown option %s" % arg, file=sys.stderr)
             return 2
         else:
             paths.append(arg)
+        i += 1
 
     try:
+        baseline: Set[Tuple[str, str, str]] = set()
+        if baseline_path is not None:
+            baseline = _load_baseline(baseline_path)
+
         findings: List[Finding] = run_graftlint(paths or None)
+        if graftcheck:
+            from .graftcheck import run_graftcheck
+            scope = ([_rel_to_package(p) for p in paths] if paths
+                     else None)
+            findings += run_graftcheck(paths=scope)
         if typegate:
             if paths:
                 # explicit paths scope the run but must not silently
                 # waive the typing bar for gated modules among them
-                import os
-
-                from .graftlint import package_root
                 root = package_root()
                 gated = [p for p in paths
-                         if os.path.relpath(
-                             os.path.abspath(p), root).replace(
-                                 os.sep, "/") in gated_modules(root)]
+                         if _rel_to_package(p) in gated_modules(root)]
                 if gated:
                     findings += run_typegate(gated)
             else:
                 findings += run_typegate()
+        if baseline:
+            findings = [f for f in findings
+                        if (_norm_path(f.path), f.rule, f.message)
+                        not in baseline]
     except Exception as ex:  # internal error must not read as "clean"
         print("graftlint internal error: %s" % ex, file=sys.stderr)
         return 2
@@ -72,7 +146,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for f in findings:
             print(f.render())
-        n_lint = sum(1 for f in findings if f.rule in RULES)
+        n_lint = sum(1 for f in findings
+                     if f.rule in RULES or f.rule.startswith("GC"))
         n_type = len(findings) - n_lint
         if findings:
             print("graftlint: %d finding(s) (%d lint, %d typing)"

@@ -1,0 +1,875 @@
+"""Package-wide symbol table + call graph for graftcheck.
+
+Pure-stdlib AST analysis over every module in the package (or any
+in-memory {relpath: source} mapping — the seeded-violation harness
+feeds mutated copies through the same entry point):
+
+  * module resolution and import following, including re-exports
+    through package `__init__` modules (both plain `from .x import y`
+    re-exports and the PEP 562 `_EXPORTS` lazy dict the package root
+    uses);
+  * attribute/method binding for the classes the package actually has:
+    methods through `self.meth(...)`, instance attributes whose class
+    is inferable from `self.attr = ClassName(...)` assignments
+    (`self.lat_hist.observe(...)` binds to `_Histogram.observe`),
+    base-class methods through `super().meth(...)` and plain
+    inheritance;
+  * closure and factory resolution: a factory's returned local defs
+    (`_fused_step_body` -> its `step`), `functools.partial(f, ...)`
+    unwrapping, and local defs passed by name into higher-order calls
+    (`jax.lax.scan(body, ...)`, `shard_map(body, ...)`) — those bodies
+    are invoked by the transform, so they are call-graph edges;
+  * decorator unwrapping: decorations never hide a def, and
+    `@contract.*` decorations are parsed into a per-function contract
+    table (analysis/contracts.py) the checking rules consume;
+  * per-function EFFECT records (host syncs, collectives, RNG/clock
+    reads, lazy jax imports, lock acquisitions) over the function's
+    OWN statements — nested defs are their own nodes, reached through
+    the closure;
+  * the module-level import graph (TYPE_CHECKING blocks excluded) with
+    per-module jax flags and `__jax_free__` declarations, for the
+    transitive jax-reach rule.
+
+Resolution is deliberately conservative: a call that cannot be bound
+to a package function is simply not an edge (external library calls,
+values passed in as parameters).  The seeded-violation harness
+(analysis/mutations.py) proves the edges that matter exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .contracts import COLLECTIVE_OPS, JAX_FREE_MARKER
+from .graftlint import (_attach_parents, _dotted, iter_package_files,
+                        package_root)
+
+__jax_free__ = True
+
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "sleep",
+               "process_time", "perf_counter_ns", "time_ns",
+               "monotonic_ns"}
+_HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.ascontiguousarray", "numpy.ascontiguousarray",
+    "np.frombuffer", "numpy.frombuffer",
+    "jax.device_get", "jax.device_put",
+}
+
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Binding:
+    """What a module-local name imported from elsewhere refers to."""
+    kind: str                      # "module" | "symbol" | "external"
+    module: str = ""               # package-relative path for module/symbol
+    symbol: str = ""               # original name for kind == "symbol"
+    external: str = ""             # root package name for kind == "external"
+
+
+@dataclasses.dataclass
+class Effects:
+    """Observable effects of ONE function's own statements."""
+    host_syncs: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)           # (line, what)
+    collectives: Set[str] = dataclasses.field(default_factory=set)
+    rng_clock: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+    jax_imports: List[int] = dataclasses.field(default_factory=list)
+    acquired_locks: Set[str] = dataclasses.field(default_factory=set)
+    device_gets: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qual: str                      # "models/gbdt.py::GBDT._train_tree"
+    name: str
+    module: "ModuleInfo"
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    cls: Optional["ClassInfo"]
+    parent: Optional["FunctionInfo"]
+    contracts: Dict[str, Dict[str, object]] = dataclasses.field(
+        default_factory=dict)
+    nested: List["FunctionInfo"] = dataclasses.field(default_factory=list)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+    @property
+    def is_public(self) -> bool:
+        return not self.name.startswith("_")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    base_names: List[str]
+    methods: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    bases: List["ClassInfo"] = dataclasses.field(default_factory=list)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+    def find_method(self, name: str) -> Optional[FunctionInfo]:
+        """MRO-ish lookup: own methods first, then package bases."""
+        seen: Set[int] = set()
+        queue: List[ClassInfo] = [self]
+        while queue:
+            c = queue.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            m = c.methods.get(name)
+            if m is not None:
+                return m
+            queue.extend(c.bases)
+        return None
+
+    def find_attr_type(self, attr: str) -> Optional[str]:
+        seen: Set[int] = set()
+        queue: List[ClassInfo] = [self]
+        while queue:
+            c = queue.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            t = c.attr_types.get(attr)
+            if t is not None:
+                return t
+            queue.extend(c.bases)
+        return None
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    rel: str
+    tree: ast.Module
+    functions: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)      # top-level defs by name
+    all_functions: List[FunctionInfo] = dataclasses.field(
+        default_factory=list)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    imports: Dict[str, Binding] = dataclasses.field(default_factory=dict)
+    module_imports: Set[str] = dataclasses.field(default_factory=set)
+    jax_module_level: bool = False
+    jax_free: Optional[bool] = None
+    exports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)      # name -> (module rel, original name)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+
+@dataclasses.dataclass
+class Edge:
+    callee: FunctionInfo
+    line: int
+    call: Optional[ast.Call]
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+def _module_level_stmts(body: Iterable[ast.stmt]) -> Iterator[ast.stmt]:
+    """Module-level statements, descending into if/try blocks (those
+    still execute at import time) but skipping TYPE_CHECKING guards."""
+    for node in body:
+        if isinstance(node, ast.If):
+            test = _dotted(node.test)
+            if test in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+                # the guarded body never runs — but its ELSE branch
+                # runs in every real process
+                yield from _module_level_stmts(node.orelse)
+                continue
+            yield from _module_level_stmts(node.body)
+            yield from _module_level_stmts(node.orelse)
+        elif isinstance(node, ast.Try):
+            yield from _module_level_stmts(node.body)
+            yield from _module_level_stmts(node.orelse)
+            yield from _module_level_stmts(node.finalbody)
+            for h in node.handlers:
+                yield from _module_level_stmts(h.body)
+        else:
+            yield node
+
+
+def own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a def's body without descending into nested defs (those are
+    their own FunctionInfos); lambdas stay inline."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _contract_of_decorator(dec: ast.AST) -> Optional[Tuple[str,
+                                                           Dict[str, object]]]:
+    """Parse one decorator expression into (contract name, args)."""
+    call = dec if isinstance(dec, ast.Call) else None
+    target = call.func if call is not None else dec
+    dotted = _dotted(target)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    if len(parts) < 2 or parts[-2] != "contract":
+        return None
+    name = parts[-1]
+    args: Dict[str, object] = {}
+    if call is not None:
+        consts: List[object] = []
+        for a in call.args:
+            if isinstance(a, ast.Constant):
+                consts.append(a.value)
+        if name == "parity_oracle" and consts:
+            args["note"] = consts[0]
+        if name == "locked_by" and consts:
+            args["lock"] = consts[0]
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                args[kw.arg] = tuple(
+                    el.value for el in kw.value.elts
+                    if isinstance(el, ast.Constant))
+            elif isinstance(kw.value, ast.Constant):
+                args[kw.arg] = kw.value.value
+    return name, args
+
+
+def _lockish_name(expr: ast.AST) -> Optional[str]:
+    """Last component of a with-context expression that looks like a
+    lock/condition ('self._lock' -> '_lock')."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    dotted = _dotted(expr)
+    if not dotted:
+        return None
+    last = dotted.split(".")[-1]
+    low = last.lower()
+    if "lock" in low or low.endswith("_cv") or low == "cv":
+        return last
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The graph
+# ---------------------------------------------------------------------------
+
+# (rel, source) -> parsed tree, shared across CallGraph instances: the
+# seeded-violation harness analyzes ~15 package images that differ in
+# ONE module each, so all unchanged modules parse once.  Safe to share
+# because nothing mutates the trees beyond the idempotent parent links.
+_PARSE_CACHE: Dict[Tuple[str, int], ast.Module] = {}
+_PARSE_CACHE_MAX = 256
+
+
+def _parse_cached(rel: str, source: str) -> ast.Module:
+    key = (rel, hash(source))
+    tree = _PARSE_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(source, filename=rel)
+        _attach_parents(tree)
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[key] = tree
+    return tree
+
+
+class CallGraph:
+    def __init__(self, sources: Dict[str, str],
+                 pkg_name: str = "lightgbm_tpu"):
+        self.pkg_name = pkg_name
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[Tuple[str, str]] = []
+        self._edge_cache: Dict[FunctionInfo, List[Edge]] = {}
+        self._effect_cache: Dict[FunctionInfo, Effects] = {}
+        for rel in sorted(sources):
+            try:
+                tree = _parse_cached(rel, sources[rel])
+            except SyntaxError as ex:
+                self.errors.append((rel, "syntax error: %s" % ex.msg))
+                continue
+            self.modules[rel] = ModuleInfo(rel=rel, tree=tree)
+        for mod in self.modules.values():
+            self._collect_module(mod)
+        for mod in self.modules.values():
+            self._resolve_bases(mod)
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_root(cls, root: Optional[str] = None) -> "CallGraph":
+        root = root or package_root()
+        sources: Dict[str, str] = {}
+        for path in iter_package_files(root):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+        return cls(sources, pkg_name=os.path.basename(root))
+
+    def _resolve_import(self, mod: ModuleInfo, level: int,
+                        module: Optional[str]) -> Optional[str]:
+        """Import statement -> package-relative directory/module path
+        prefix, or None for out-of-package imports."""
+        if level == 0:
+            name = module or ""
+            if name == self.pkg_name:
+                return ""
+            if name.startswith(self.pkg_name + "."):
+                return name[len(self.pkg_name) + 1:].replace(".", "/")
+            return None
+        base = os.path.dirname(mod.rel)
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        part = (module or "").replace(".", "/")
+        return ("%s/%s" % (base, part)).strip("/") if part else base
+
+    def _module_at(self, path: Optional[str]) -> Optional[str]:
+        """Path prefix -> actual module rel ('io/binning' ->
+        'io/binning.py'; 'io' -> 'io/__init__.py'; '' -> '__init__.py')."""
+        if path is None:
+            return None
+        if path == "":
+            return "__init__.py" if "__init__.py" in self.modules else None
+        for cand in (path + ".py", path + "/__init__.py"):
+            if cand in self.modules:
+                return cand
+        return None
+
+    def _collect_module(self, mod: ModuleInfo) -> None:
+        # defs/classes (every nesting level)
+        self._collect_defs(mod, mod.tree, prefix="", cls=None, parent=None)
+
+        # imports: whole-module bindings + module-level import graph
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root_name = alias.name.split(".")[0]
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = self._module_at(
+                        self._resolve_import(mod, 0, alias.name))
+                    if target is not None:
+                        mod.imports[local] = Binding("module", module=target)
+                    else:
+                        mod.imports[local] = Binding("external",
+                                                     external=root_name)
+            elif isinstance(node, ast.ImportFrom):
+                root_name = (node.module or "").split(".")[0]
+                path = self._resolve_import(mod, node.level, node.module)
+                if path is None:
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        mod.imports[local] = Binding("external",
+                                                     external=root_name)
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub = self._module_at(
+                        ("%s/%s" % (path, alias.name)).strip("/"))
+                    if sub is not None:
+                        mod.imports[local] = Binding("module", module=sub)
+                    else:
+                        src = self._module_at(path)
+                        if src is not None:
+                            mod.imports[local] = Binding(
+                                "symbol", module=src, symbol=alias.name)
+
+        # module-level import graph + jax flag
+        for node in _module_level_stmts(mod.tree.body):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] in ("jax", "jaxlib"):
+                        mod.jax_module_level = True
+                    t = self._module_at(
+                        self._resolve_import(mod, 0, alias.name))
+                    if t is not None:
+                        mod.module_imports.add(t)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0 \
+                        and (node.module or "").split(".")[0] in (
+                            "jax", "jaxlib"):
+                    mod.jax_module_level = True
+                path = self._resolve_import(mod, node.level, node.module)
+                if path is not None:
+                    for alias in node.names:
+                        sub = self._module_at(
+                            ("%s/%s" % (path, alias.name)).strip("/"))
+                        if sub is not None:
+                            mod.module_imports.add(sub)
+                    # importing anything from a package executes the
+                    # package module itself, so it is always an edge
+                    src = self._module_at(path)
+                    if src is not None:
+                        mod.module_imports.add(src)
+            elif isinstance(node, ast.Assign):
+                # __jax_free__ marker; _EXPORTS lazy re-export dict
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id == JAX_FREE_MARKER \
+                            and isinstance(node.value, ast.Constant) \
+                            and isinstance(node.value.value, bool):
+                        mod.jax_free = node.value.value
+                    if isinstance(t, ast.Name) and t.id == "_EXPORTS" \
+                            and isinstance(node.value, ast.Dict):
+                        self._collect_exports_dict(mod, node.value)
+
+        # plain re-exports: every from-import alias in an __init__
+        # module is re-exported under its local name (covers both
+        # module-level re-exports and the PEP 562 __getattr__ pattern)
+        if os.path.basename(mod.rel) == "__init__.py":
+            for name, b in mod.imports.items():
+                if b.kind == "symbol":
+                    mod.exports[name] = (b.module, b.symbol)
+                elif b.kind == "module":
+                    mod.exports[name] = (b.module, "")
+
+    def _collect_exports_dict(self, mod: ModuleInfo,
+                              node: ast.Dict) -> None:
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and isinstance(
+                    k.value, str) and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                continue
+            dotted = v.value  # ".models.gbdt" relative to this package
+            level = 0
+            while dotted.startswith("."):
+                level += 1
+                dotted = dotted[1:]
+            target = self._module_at(
+                self._resolve_import(mod, max(level, 1), dotted or None))
+            if target is not None:
+                mod.exports[k.value] = (target, k.value)
+
+    def _collect_defs(self, mod: ModuleInfo, tree: ast.AST, prefix: str,
+                      cls: Optional[ClassInfo],
+                      parent: Optional[FunctionInfo]) -> None:
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = "%s::%s%s" % (mod.rel, prefix, node.name)
+                contracts: Dict[str, Dict[str, object]] = {}
+                for dec in node.decorator_list:
+                    parsed = _contract_of_decorator(dec)
+                    if parsed is not None:
+                        contracts[parsed[0]] = parsed[1]
+                fi = FunctionInfo(qual=qual, name=node.name, module=mod,
+                                  node=node, cls=cls, parent=parent,
+                                  contracts=contracts)
+                mod.all_functions.append(fi)
+                if parent is not None:
+                    parent.nested.append(fi)
+                elif cls is not None:
+                    cls.methods[node.name] = fi
+                else:
+                    mod.functions[node.name] = fi
+                self._collect_defs(mod, node, prefix + node.name + ".",
+                                   cls=cls, parent=fi)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, module=mod, node=node,
+                               base_names=[
+                                   d for d in (_dotted(b)
+                                               for b in node.bases)
+                                   if d is not None])
+                mod.classes[node.name] = ci
+                self._collect_defs(mod, node,
+                                   prefix + node.name + ".",
+                                   cls=ci, parent=None)
+                self._collect_attr_types(ci)
+            else:
+                # defs inside module-level if/try blocks still exist
+                if isinstance(node, (ast.If, ast.Try, ast.With)):
+                    self._collect_defs(mod, node, prefix, cls, parent)
+
+    def _collect_attr_types(self, ci: ClassInfo) -> None:
+        """`self.attr = ClassName(...)` anywhere in the class body."""
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                name = _dotted(v.func)
+                if name is not None:
+                    ci.attr_types.setdefault(t.attr, name)
+
+    def _resolve_bases(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for base in ci.base_names:
+                resolved = self.resolve_class(mod, base)
+                if resolved is not None:
+                    ci.bases.append(resolved)
+
+    # -- symbol resolution ---------------------------------------------
+    def resolve_class(self, mod: ModuleInfo,
+                      dotted: str) -> Optional[ClassInfo]:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            ci = mod.classes.get(parts[0])
+            if ci is not None:
+                return ci
+            b = mod.imports.get(parts[0])
+            if b is not None and b.kind == "symbol":
+                return self._class_in(b.module, b.symbol)
+            return None
+        b = mod.imports.get(parts[0])
+        if b is not None and b.kind == "module" and len(parts) == 2:
+            return self._class_in(b.module, parts[1])
+        return None
+
+    def _class_in(self, module_rel: str,
+                  name: str) -> Optional[ClassInfo]:
+        m = self.modules.get(module_rel)
+        if m is None:
+            return None
+        ci = m.classes.get(name)
+        if ci is not None:
+            return ci
+        exp = m.exports.get(name)
+        if exp is not None and exp[1]:
+            return self._class_in(exp[0], exp[1])
+        return None
+
+    def _function_in(self, module_rel: str,
+                     name: str) -> Optional[FunctionInfo]:
+        m = self.modules.get(module_rel)
+        if m is None:
+            return None
+        fi = m.functions.get(name)
+        if fi is not None:
+            return fi
+        ci = m.classes.get(name)
+        if ci is not None:
+            init = ci.find_method("__init__")
+            if init is not None:
+                return init
+        exp = m.exports.get(name)
+        if exp is not None and exp[1]:
+            return self._function_in(exp[0], exp[1])
+        return None
+
+    def function(self, qual: str) -> Optional[FunctionInfo]:
+        rel = qual.partition("::")[0]
+        m = self.modules.get(rel)
+        if m is None:
+            return None
+        for fi in m.all_functions:
+            if fi.qual == qual:
+                return fi
+        return None
+
+    def contracted(self, name: str) -> List[FunctionInfo]:
+        """Every function in the graph carrying the named contract."""
+        out = []
+        for m in self.modules.values():
+            for fi in m.all_functions:
+                if name in fi.contracts:
+                    out.append(fi)
+        return out
+
+    def _resolve_name(self, fn: FunctionInfo,
+                      name: str) -> List[FunctionInfo]:
+        """A bare name used inside `fn` -> function(s) it denotes."""
+        # lexical: nested defs of enclosing functions, innermost first
+        cur: Optional[FunctionInfo] = fn
+        while cur is not None:
+            for nested in cur.nested:
+                if nested.name == name:
+                    return [nested]
+            cur = cur.parent
+        mod = fn.module
+        if name in mod.functions:
+            return [mod.functions[name]]
+        ci = mod.classes.get(name)
+        if ci is not None:
+            init = ci.find_method("__init__")
+            return [init] if init is not None else []
+        b = mod.imports.get(name)
+        if b is not None:
+            if b.kind == "symbol":
+                hit = self._function_in(b.module, b.symbol)
+                return [hit] if hit is not None else []
+            return []
+        return []
+
+    def _resolve_callee_expr(self, fn: FunctionInfo,
+                             expr: ast.AST) -> List[FunctionInfo]:
+        """Function(s) the expression `expr` denotes at a call site."""
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(fn, expr.id)
+        if isinstance(expr, ast.IfExp):
+            return (self._resolve_callee_expr(fn, expr.body)
+                    + self._resolve_callee_expr(fn, expr.orelse))
+        if isinstance(expr, ast.Call):
+            # calling the RESULT of a call: factory().  Resolve the
+            # factory, then its returned closures.
+            inner = _dotted(expr.func)
+            if inner in ("functools.partial", "partial") and expr.args:
+                return self._resolve_callee_expr(fn, expr.args[0])
+            out: List[FunctionInfo] = []
+            for factory in self._resolve_callee_expr(fn, expr.func):
+                out.extend(self.returned_closures(factory))
+            return out
+        if isinstance(expr, ast.Attribute):
+            # super().meth
+            if isinstance(expr.value, ast.Call) \
+                    and isinstance(expr.value.func, ast.Name) \
+                    and expr.value.func.id == "super":
+                if fn.cls is not None:
+                    for base in fn.cls.bases:
+                        m = base.find_method(expr.attr)
+                        if m is not None:
+                            return [m]
+                return []
+            dotted = _dotted(expr)
+            if dotted is None:
+                return []
+            parts = dotted.split(".")
+            if parts[0] == "self" and fn.cls is not None:
+                if len(parts) == 2:
+                    m = fn.cls.find_method(parts[1])
+                    return [m] if m is not None else []
+                if len(parts) == 3:
+                    t = fn.cls.find_attr_type(parts[1])
+                    if t is not None:
+                        ci = self.resolve_class(fn.module, t)
+                        if ci is not None:
+                            m = ci.find_method(parts[2])
+                            return [m] if m is not None else []
+                return []
+            b = fn.module.imports.get(parts[0])
+            if b is not None and b.kind == "module" and len(parts) == 2:
+                hit = self._function_in(b.module, parts[1])
+                return [hit] if hit is not None else []
+            if len(parts) == 2:
+                ci = fn.module.classes.get(parts[0])
+                if ci is None and b is not None and b.kind == "symbol":
+                    ci = self._class_in(b.module, b.symbol)
+                if ci is not None:
+                    m = ci.find_method(parts[1])
+                    return [m] if m is not None else []
+            return []
+        return []
+
+    def returned_closures(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Local defs a factory returns — directly (`return step`),
+        through a wrapper call (`return jax.jit(body)`), or behind a
+        conditional expression."""
+        out: List[FunctionInfo] = []
+
+        def from_expr(node: ast.AST) -> None:
+            if isinstance(node, ast.Name):
+                for nested in fn.nested:
+                    if nested.name == node.id:
+                        out.append(nested)
+            elif isinstance(node, ast.IfExp):
+                from_expr(node.body)
+                from_expr(node.orelse)
+            elif isinstance(node, ast.Call):
+                for a in node.args:
+                    from_expr(a)
+
+        for node in own_nodes(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                from_expr(node.value)
+        return out
+
+    # -- edges ----------------------------------------------------------
+    def callees(self, fn: FunctionInfo) -> List[Edge]:
+        cached = self._edge_cache.get(fn)
+        if cached is not None:
+            return cached
+        edges: List[Edge] = []
+        seen: Set[Tuple[int, int]] = set()
+
+        def add(target: FunctionInfo, node: ast.AST,
+                call: Optional[ast.Call]) -> None:
+            key = (id(target), getattr(node, "lineno", 0))
+            if key in seen:
+                return
+            seen.add(key)
+            edges.append(Edge(callee=target,
+                              line=getattr(node, "lineno", 0), call=call))
+
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for target in self._resolve_callee_expr(fn, node.func):
+                add(target, node, node)
+            # local defs passed by name into a higher-order call are
+            # invoked by it (lax.scan/cond bodies, shard_map, jit, ...);
+            # functools.partial(f, ...) arguments unwrap to f
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    for target in self._resolve_callee_expr(fn, arg):
+                        add(target, node, node)
+                elif isinstance(arg, ast.Call):
+                    inner = _dotted(arg.func)
+                    if inner in ("functools.partial", "partial") \
+                            and arg.args:
+                        for target in self._resolve_callee_expr(
+                                fn, arg.args[0]):
+                            add(target, node, node)
+        self._edge_cache[fn] = edges
+        return edges
+
+    # -- reach ----------------------------------------------------------
+    def reach(self, roots: Iterable[FunctionInfo]
+              ) -> Dict[FunctionInfo, Optional[FunctionInfo]]:
+        """BFS closure over call edges + nested defs + returned
+        closures; maps each reached function to its BFS parent (None
+        for roots) so rules can render the call chain."""
+        parent: Dict[FunctionInfo, Optional[FunctionInfo]] = {}
+        queue: List[FunctionInfo] = []
+        for r in roots:
+            if r not in parent:
+                parent[r] = None
+                queue.append(r)
+        while queue:
+            fn = queue.pop(0)
+            succ: List[FunctionInfo] = [e.callee for e in self.callees(fn)]
+            succ.extend(fn.nested)
+            succ.extend(self.returned_closures(fn))
+            for s in succ:
+                if s not in parent:
+                    parent[s] = fn
+                    queue.append(s)
+        return parent
+
+    @staticmethod
+    def chain(parent: Dict[FunctionInfo, Optional[FunctionInfo]],
+              fn: FunctionInfo) -> List[FunctionInfo]:
+        out = [fn]
+        cur = parent.get(fn)
+        while cur is not None:
+            out.append(cur)
+            cur = parent.get(cur)
+        out.reverse()
+        return out
+
+    # -- effects --------------------------------------------------------
+    def effects(self, fn: FunctionInfo) -> Effects:
+        cached = self._effect_cache.get(fn)
+        if cached is not None:
+            return cached
+        eff = Effects()
+        for node in own_nodes(fn.node):
+            line = getattr(node, "lineno", 0)
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if dotted in _HOST_SYNC_CALLS:
+                    eff.host_syncs.append((line, dotted or ""))
+                    if dotted == "jax.device_get":
+                        eff.device_gets.append(line)
+                elif isinstance(node.func, ast.Attribute) \
+                        and not node.args and not node.keywords:
+                    if node.func.attr == "item":
+                        eff.host_syncs.append((line, ".item()"))
+                    elif node.func.attr == "block_until_ready":
+                        eff.host_syncs.append((line,
+                                               ".block_until_ready()"))
+                if dotted is not None:
+                    parts = dotted.split(".")
+                    if len(parts) >= 2 and parts[-2] == "lax" \
+                            and parts[-1] in COLLECTIVE_OPS:
+                        eff.collectives.add(parts[-1])
+            elif isinstance(node, ast.Attribute):
+                dotted = _dotted(node)
+                if dotted in ("np.random", "numpy.random"):
+                    eff.rng_clock.append((line, dotted or ""))
+                elif dotted is not None and "." in dotted:
+                    head, _, attr = dotted.rpartition(".")
+                    if head == "time" and attr in _TIME_ATTRS:
+                        eff.rng_clock.append((line, dotted))
+                    elif head == "random":
+                        eff.rng_clock.append((line, dotted))
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    root_name = alias.name.split(".")[0]
+                    if root_name in ("jax", "jaxlib"):
+                        eff.jax_imports.append(line)
+                    if root_name in ("time", "random"):
+                        eff.rng_clock.append((line,
+                                              "import %s" % alias.name))
+            elif isinstance(node, ast.ImportFrom):
+                root_name = (node.module or "").split(".")[0]
+                if node.level == 0 and root_name in ("jax", "jaxlib"):
+                    eff.jax_imports.append(line)
+                if node.level == 0 and root_name in ("time", "random"):
+                    eff.rng_clock.append((line,
+                                          "from %s import ..." % root_name))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    lock = _lockish_name(item.context_expr)
+                    if lock is not None:
+                        eff.acquired_locks.add(lock)
+        self._effect_cache[fn] = eff
+        return eff
+
+    # -- module import closure -----------------------------------------
+    def jax_reach_chain(self, rel: str) -> Optional[List[str]]:
+        """Shortest module-import chain from `rel` to a module that
+        imports jax at module level (None when unreachable).  The chain
+        includes `rel` and ends at the jax-importing module."""
+        start = self.modules.get(rel)
+        if start is None:
+            return None
+        if start.jax_module_level:
+            return [rel]
+        parent: Dict[str, Optional[str]] = {rel: None}
+        queue = [rel]
+        while queue:
+            cur = queue.pop(0)
+            m = self.modules.get(cur)
+            if m is None:
+                continue
+            for nxt in sorted(m.module_imports):
+                if nxt in parent:
+                    continue
+                parent[nxt] = cur
+                nm = self.modules.get(nxt)
+                if nm is not None and nm.jax_module_level:
+                    chain = [nxt]
+                    back: Optional[str] = cur
+                    while back is not None:
+                        chain.append(back)
+                        back = parent[back]
+                    chain.reverse()
+                    return chain
+                queue.append(nxt)
+        return None
+
+    def call_sites_of(self, target: FunctionInfo
+                      ) -> List[Tuple[FunctionInfo, ast.Call]]:
+        """Every package call site resolving to `target`."""
+        out: List[Tuple[FunctionInfo, ast.Call]] = []
+        for m in self.modules.values():
+            for fn in m.all_functions:
+                for e in self.callees(fn):
+                    if e.callee is target and e.call is not None:
+                        out.append((fn, e.call))
+        return out

@@ -1,0 +1,242 @@
+"""graftcheck contract registry — invariants declared at the definition
+site, verified whole-program by analysis/graftcheck.py.
+
+graftlint (graftlint.py) checks invariants it can see from ONE module's
+AST.  The contracts here carry the invariants that are only meaningful
+across modules: a fused step body must stay trace-pure through every
+helper it calls (ops/grow.py, ops/predict.py, ...), a jax-free module
+must stay jax-free through its whole import closure, a serving mutator
+is only correct if every call path into it holds the lock.  Each
+decorator is a ZERO-COST runtime no-op (it tags and returns the
+function unchanged — stdlib only, safe in jax-free modules and on hot
+paths); the analyzer reads the decoration from the AST, so the checks
+run without importing the annotated code.
+
+Contract classes (checking rules live in graftcheck.py):
+
+  @contract.traced_pure
+      This function (and, for factories, the closures it returns) is
+      device code: nothing it TRANSITIVELY calls inside the package may
+      host-sync (np.asarray/np.array, jax.device_get/put, .item(),
+      .block_until_ready()).  Rule GC001.
+
+  @contract.parity_oracle("why this path is the oracle")
+      This function is a bit-parity oracle (PARITY.md): the K=1 /
+      masked / general paths other configurations are tested against.
+      Nothing it transitively calls may read the clock or any RNG
+      outside utils/mt19937, and the set of oracles is pinned by
+      EXPECTED_PARITY_ORACLES — removing or renaming an annotation is
+      itself a finding.  Rule GC003.
+
+  @contract.jax_free
+      This function must be callable without jax entering sys.modules:
+      nothing it transitively calls may import jax, not even lazily
+      inside a function body.  (Module-granular jax-freedom is declared
+      with a module-level `__jax_free__ = True` marker instead — see
+      below.)  Rule GC002.
+
+  @contract.locked_by("_lock")
+      Every self.* store in this function is protected by the named
+      lock, which the CALLER holds: the analyzer verifies every package
+      call path into the function lexically holds a `with <...name>:`
+      (or passes through another function with the same contract), and
+      graftlint GL006 stops demanding per-line suppressions inside it.
+      Rule GC004.
+
+  @contract.fused_body(extras=(...), collectives=(...))
+      This step MAKER builds one of the fused training-step bodies
+      (models/gbdt.py).  The analyzer resolves the maker to its body
+      closure(s) through the call graph and verifies the body's EFFECT
+      SIGNATURE: it consumes exactly the FUSED_CORE inputs plus the
+      declared extras (parameter names normalized via CONSUME_KINDS),
+      its transitive collective set equals the declared one, and every
+      maker declares the SAME collectives — so any drift between the
+      six bodies that would break the planned composable fused-step
+      builder (ROADMAP) is a lint error today.  The full maker set is
+      pinned by EXPECTED_FUSED_BODIES.  Rule GC005.
+
+  @contract.counted_flush
+      This function is a sanctioned deferred-flush site: the ONLY place
+      allowed to call jax.device_get, so analysis/guards.py transfer
+      accounting (bench's device_gets_per_100_trees) cannot silently
+      under-count when a new code path materializes device buffers.
+      Rule GC006.
+
+Module marker — jax-free modules declare themselves:
+
+    __jax_free__ = True     # module + its import closure never pull jax
+
+graftlint GL002 discovers its module set from this marker (the
+hard-coded list is gone), graftcheck GC002 verifies the whole import
+closure, and GC007 requires every module under DECLARE_DIRS to carry
+an explicit `__jax_free__ = True/False` so a new serving/io module
+cannot silently escape the gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Tuple, TypeVar
+
+__jax_free__ = True
+
+F = TypeVar("F", bound=Callable[..., Any])
+
+#: attribute the runtime decorators tag functions with (introspection
+#: convenience only — the analyzer reads the AST, never this attribute)
+CONTRACT_ATTR = "__contracts__"
+
+#: module-level marker name declaring a module's jax-freedom
+JAX_FREE_MARKER = "__jax_free__"
+
+#: package directories where EVERY module must declare __jax_free__
+#: explicitly (True or False) — rule GC007.  A new module dropped into
+#: one of these trees is a finding until its author states the import
+#: contract one way or the other.
+DECLARE_DIRS: Tuple[str, ...] = ("serving", "io", "utils", "analysis",
+                                 "native", "parallel", "models")
+
+#: modules PINNED jax-free: these must declare `__jax_free__ = True` —
+#: deleting the marker (or flipping it to False) is a finding (GC007),
+#: exactly like removing a parity-oracle annotation.  This is the old
+#: hard-coded GL002 list reborn as a registry: discovery governs the
+#: GATE (any marked module is checked), the registry governs the SET
+#: (the load-bearing fast paths cannot silently leave it).
+EXPECTED_JAX_FREE: Tuple[str, ...] = (
+    "__init__.py", "__main__.py", "cli.py", "config.py",
+    "predict_fast.py",
+    "io/__init__.py", "io/parser.py", "io/binning.py", "io/dataset.py",
+    "models/__init__.py", "models/tree.py",
+    "native/__init__.py",
+    "parallel/__init__.py", "parallel/dist.py",
+    "serving/__init__.py", "serving/forest.py", "serving/batcher.py",
+    "serving/server.py",
+    "utils/__init__.py", "utils/log.py", "utils/mt19937.py",
+    "utils/compile_cache.py",
+)
+
+# ---------------------------------------------------------------------------
+# Fused-body effect signature vocabulary (rule GC005)
+# ---------------------------------------------------------------------------
+
+#: canonical inputs EVERY fused step body consumes — the uniform core
+#: the composable fused-step builder will be written against
+FUSED_CORE: Tuple[str, ...] = ("scores", "valid_scores", "bag", "fmask",
+                               "bins", "valid_bins", "gstate", "stopped")
+
+#: body parameter name -> canonical effect-input kind.  A body parameter
+#: whose name is missing here is an UNDECLARED input kind (a finding):
+#: extend this table deliberately when the builder grows a new input.
+CONSUME_KINDS: Mapping[str, str] = {
+    "scores": "scores",
+    "valid_scores": "valid_scores",
+    "bag_mask": "bag", "bag_masks": "bag",
+    "fmask": "fmask", "fmasks": "fmask",
+    "bins": "bins",
+    "valid_bins": "valid_bins",
+    "gstate": "gstate",
+    "stopped": "stopped",
+    "row_order": "order",
+    # DART device-bank inputs
+    "bank_i": "bank", "bank_f": "bank", "leaf_bank": "bank",
+    "vbanks": "bank", "t_row": "bank",
+    # DART drop/normalize schedule inputs
+    "drop_idx": "dart", "drop_mask": "dart", "lr": "dart", "kf": "dart",
+}
+
+#: collective primitives (matched as jax.lax.X / lax.X in the AST)
+COLLECTIVE_OPS: Tuple[str, ...] = (
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index",
+)
+
+# ---------------------------------------------------------------------------
+# Registries: the annotation SET is part of the contract
+# ---------------------------------------------------------------------------
+
+#: the six fused step makers (qualnames are "<module relpath>::<path>"
+#: as analysis/callgraph.py renders them).  graftcheck verifies the
+#: @contract.fused_body annotation set equals this registry exactly:
+#: removing, renaming or adding a maker without updating the registry
+#: is a finding (GC005).
+EXPECTED_FUSED_BODIES: Tuple[str, ...] = (
+    "models/gbdt.py::_make_fused_step",
+    "models/gbdt.py::_make_fused_step_reorder",
+    "models/gbdt.py::_make_fused_step_dart",
+    "models/gbdt.py::_make_fused_step_multi",
+    "models/gbdt.py::_make_fused_step_multi_sharded",
+    "models/gbdt.py::_make_fused_step_sharded",
+)
+
+#: the bit-parity oracle paths (PARITY.md / CONTRACTS.md).  graftcheck
+#: verifies the @contract.parity_oracle annotation set equals this
+#: registry exactly (GC003).
+EXPECTED_PARITY_ORACLES: Tuple[str, ...] = (
+    # the general per-tree path: one grow dispatch per tree, the oracle
+    # every fused path is structure/value-tested against
+    "models/gbdt.py::GBDT._train_tree",
+    # K=1 pass-through: iteration batching returns the body UNCHANGED,
+    # so K>1 is bit-parity with the per-iteration oracle by construction
+    "models/gbdt.py::_batch_iters",
+    # the plain fused body: bag_compact=off / masked-bagging oracle
+    "models/gbdt.py::_fused_step_body",
+    # the growth kernel under full-length masked bagging
+    "ops/grow.py::grow_tree",
+)
+
+
+def _tag(fn: F, name: str, args: Dict[str, Any]) -> F:
+    """Attach contract metadata; never fail on exotic callables."""
+    try:
+        contracts = getattr(fn, CONTRACT_ATTR, None)
+        if contracts is None:
+            contracts = {}
+            setattr(fn, CONTRACT_ATTR, contracts)
+        contracts[name] = args
+    except (AttributeError, TypeError):  # pragma: no cover - jit wrappers
+        pass
+    return fn
+
+
+class _Contract:
+    """The `contract` namespace — every member is a no-op tagger."""
+
+    @staticmethod
+    def traced_pure(fn: F) -> F:
+        return _tag(fn, "traced_pure", {})
+
+    @staticmethod
+    def parity_oracle(note: str) -> Callable[[F], F]:
+        def deco(fn: F) -> F:
+            return _tag(fn, "parity_oracle", {"note": note})
+        return deco
+
+    @staticmethod
+    def jax_free(fn: F) -> F:
+        return _tag(fn, "jax_free", {})
+
+    @staticmethod
+    def locked_by(lock: str) -> Callable[[F], F]:
+        def deco(fn: F) -> F:
+            return _tag(fn, "locked_by", {"lock": lock})
+        return deco
+
+    @staticmethod
+    def fused_body(extras: Tuple[str, ...] = (),
+                   collectives: Tuple[str, ...] = ()
+                   ) -> Callable[[F], F]:
+        def deco(fn: F) -> F:
+            return _tag(fn, "fused_body",
+                        {"extras": tuple(extras),
+                         "collectives": tuple(collectives)})
+        return deco
+
+    @staticmethod
+    def counted_flush(fn: F) -> F:
+        return _tag(fn, "counted_flush", {})
+
+
+contract = _Contract()
+
+__all__ = ["contract", "CONTRACT_ATTR", "JAX_FREE_MARKER", "DECLARE_DIRS",
+           "FUSED_CORE", "CONSUME_KINDS", "COLLECTIVE_OPS",
+           "EXPECTED_FUSED_BODIES", "EXPECTED_PARITY_ORACLES"]

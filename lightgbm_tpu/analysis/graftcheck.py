@@ -1,0 +1,480 @@
+"""graftcheck — whole-program contract analysis.
+
+Verifies the invariants declared at definition sites via
+analysis/contracts.py decorators (and the `__jax_free__` module
+marker), interprocedurally, over the call graph and import graph built
+by analysis/callgraph.py.  graftlint stops at the function/module
+boundary; these rules cross it:
+
+  GC001 host-sync-reached-from-traced-pure
+        A host sync (np.asarray/np.array, jax.device_get/put,
+        .item(), .block_until_ready()) anywhere in the transitive call
+        closure of a @contract.traced_pure function or a fused step
+        body — a sync three helpers deep serializes the device
+        pipeline exactly like one written inline.
+  GC002 jax-reached-from-jax-free
+        A module declaring `__jax_free__ = True` whose module-level
+        import CLOSURE reaches a jax import (any number of hops), or a
+        @contract.jax_free function whose call closure reaches a lazy
+        `import jax` — either way jax enters sys.modules on a path
+        contractually free of it.
+  GC003 parity-oracle-violation
+        The @contract.parity_oracle annotation set must equal
+        contracts.EXPECTED_PARITY_ORACLES (removing/renaming an oracle
+        annotation is itself a finding), and no oracle may transitively
+        reach the clock or RNG outside utils/mt19937.
+  GC004 lock-discipline
+        A @contract.locked_by("<lock>") function must either acquire
+        the named lock itself or be called ONLY from sites that
+        lexically hold it (or from functions carrying the same
+        contract, checked recursively) — an unlocked public entry
+        point reaching the mutator is a finding.
+  GC005 fused-body-contract
+        The @contract.fused_body annotation set must equal
+        contracts.EXPECTED_FUSED_BODIES; each maker's resolved body
+        must consume exactly the FUSED_CORE inputs plus its declared
+        extras (CONSUME_KINDS-normalized), its transitive collective
+        set must equal the declared one, and every maker must declare
+        the SAME collectives — six bodies, one effect signature, so the
+        planned composable fused-step builder can replace them without
+        surprises.
+  GC006 uncounted-device-flush
+        `jax.device_get` outside a @contract.counted_flush function:
+        every deferred flush must go through the counted wrapper so
+        analysis/guards.py transfer accounting (bench's
+        device_gets_per_100_trees) cannot silently under-count.
+  GC007 jax-free-undeclared
+        A module under contracts.DECLARE_DIRS with no explicit
+        `__jax_free__ = True/False` declaration — new serving/io/utils
+        modules must state their import contract to enter the tree.
+
+Entry points: run_graftcheck() for the installed package (or an
+explicit root), run_graftcheck_sources() for an in-memory
+{relpath: source} mapping (unit tests, the seeded-violation harness).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo, _lockish_name
+from .contracts import (CONSUME_KINDS, DECLARE_DIRS,
+                        EXPECTED_FUSED_BODIES, EXPECTED_PARITY_ORACLES,
+                        FUSED_CORE)
+from .graftlint import RULE_NAMES, Finding
+
+__jax_free__ = True
+
+CHECK_RULES: Dict[str, str] = {
+    "GC001": "host-sync-reached-from-traced-pure",
+    "GC002": "jax-reached-from-jax-free",
+    "GC003": "parity-oracle-violation",
+    "GC004": "lock-discipline",
+    "GC005": "fused-body-contract",
+    "GC006": "uncounted-device-flush",
+    "GC007": "jax-free-undeclared",
+}
+RULE_NAMES.update(CHECK_RULES)
+
+
+def _chain_str(graph: CallGraph,
+               parent: Dict[FunctionInfo, Optional[FunctionInfo]],
+               fn: FunctionInfo) -> str:
+    return " -> ".join(f.qual for f in graph.chain(parent, fn))
+
+
+def _emit(findings: List[Finding], rel: str, line: int, rule: str,
+          message: str) -> None:
+    findings.append(Finding(rel, line, rule, message))
+
+
+# ---------------------------------------------------------------------------
+# GC001 — interprocedural trace purity
+# ---------------------------------------------------------------------------
+
+def check_traced_pure(graph: CallGraph,
+                      findings: List[Finding]) -> None:
+    roots = graph.contracted("traced_pure") + graph.contracted(
+        "fused_body")
+    parent = graph.reach(roots)
+    for fn in parent:
+        eff = graph.effects(fn)
+        for line, what in eff.host_syncs:
+            _emit(findings, fn.module.rel, line, "GC001",
+                  "%s in %s is a host sync inside the traced-pure "
+                  "closure: %s"
+                  % (what, fn.qual, _chain_str(graph, parent, fn)))
+
+
+# ---------------------------------------------------------------------------
+# GC002 — transitive jax reach
+# ---------------------------------------------------------------------------
+
+def check_jax_free(graph: CallGraph, findings: List[Finding]) -> None:
+    # module granularity: the whole module-level import closure
+    for rel, mod in sorted(graph.modules.items()):
+        if mod.jax_free is not True:
+            continue
+        chain = graph.jax_reach_chain(rel)
+        if chain is not None and len(chain) > 1:
+            _emit(findings, rel, 1, "GC002",
+                  "jax-free module transitively imports jax: %s"
+                  % " -> ".join(chain))
+        elif chain is not None:
+            _emit(findings, rel, 1, "GC002",
+                  "module declares __jax_free__ = True but imports jax "
+                  "at module level")
+    # function granularity: the call closure must not execute a lazy
+    # jax import either
+    roots = graph.contracted("jax_free")
+    parent = graph.reach(roots)
+    for fn in parent:
+        eff = graph.effects(fn)
+        for line in eff.jax_imports:
+            _emit(findings, fn.module.rel, line, "GC002",
+                  "lazy jax import in %s is reachable from a "
+                  "@contract.jax_free function: %s"
+                  % (fn.qual, _chain_str(graph, parent, fn)))
+        if fn.module.jax_module_level and fn not in roots:
+            _emit(findings, fn.module.rel,
+                  getattr(fn.node, "lineno", 1), "GC002",
+                  "%s lives in a module that imports jax at module "
+                  "level but is reachable from a @contract.jax_free "
+                  "function: %s"
+                  % (fn.qual, _chain_str(graph, parent, fn)))
+
+
+# ---------------------------------------------------------------------------
+# GC003 — parity oracles
+# ---------------------------------------------------------------------------
+
+def check_parity_oracles(graph: CallGraph,
+                         findings: List[Finding]) -> None:
+    annotated = graph.contracted("parity_oracle")
+    have = {fn.qual for fn in annotated}
+    want = set(EXPECTED_PARITY_ORACLES)
+    for qual in sorted(want - have):
+        rel = qual.split("::", 1)[0]
+        _emit(findings, rel, 1, "GC003",
+              "parity oracle %s is missing its @contract.parity_oracle "
+              "annotation (registry: contracts.EXPECTED_PARITY_ORACLES "
+              "— an oracle was removed or renamed without updating the "
+              "contract)" % qual)
+    for fn in annotated:
+        if fn.qual not in want:
+            _emit(findings, fn.module.rel,
+                  getattr(fn.node, "lineno", 1), "GC003",
+                  "%s carries @contract.parity_oracle but is not in "
+                  "contracts.EXPECTED_PARITY_ORACLES — register it (the "
+                  "oracle SET is part of the contract)" % fn.qual)
+    parent = graph.reach(annotated)
+    for fn in parent:
+        eff = graph.effects(fn)
+        for line, what in eff.rng_clock:
+            _emit(findings, fn.module.rel, line, "GC003",
+                  "%s in %s is reachable from a parity oracle "
+                  "(randomness must come from utils/mt19937, no value "
+                  "may depend on the clock): %s"
+                  % (what, fn.qual, _chain_str(graph, parent, fn)))
+
+
+# ---------------------------------------------------------------------------
+# GC004 — lock discipline
+# ---------------------------------------------------------------------------
+
+def _call_under_lock(call: ast.AST, lock: str) -> bool:
+    cur = getattr(call, "_gl_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if _lockish_name(item.context_expr) == lock:
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = getattr(cur, "_gl_parent", None)
+    return False
+
+
+def check_lock_discipline(graph: CallGraph,
+                          findings: List[Finding]) -> None:
+    from .callgraph import own_nodes
+    for target in graph.contracted("locked_by"):
+        lock = str(target.contracts["locked_by"].get("lock", "_lock"))
+        if lock in graph.effects(target).acquired_locks:
+            continue  # self-acquiring: discipline holds locally
+        sites: List[Tuple[FunctionInfo, ast.Call]] = \
+            graph.call_sites_of(target)
+        resolved_ids = {id(call) for _, call in sites}
+        # resolution is conservative; a call shape the resolver cannot
+        # bind (`for h in hists: h.observe(v)` on a passed-in object)
+        # must not silently escape the contract.  Fallback: any
+        # PACKAGE-WIDE attribute call matching the mutator's name is
+        # held to the lock too.  Deliberately over-approximate — a
+        # same-named method of an unrelated class gets flagged and
+        # must rename or take the lock; for a lock rule that is the
+        # right direction to fail in.
+        for mod in graph.modules.values():
+            for fn in mod.all_functions:
+                if fn is target:
+                    continue
+                for node in own_nodes(fn.node):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == target.name \
+                            and id(node) not in resolved_ids:
+                        sites.append((fn, node))
+        if not sites:
+            _emit(findings, target.module.rel,
+                  getattr(target.node, "lineno", 1), "GC004",
+                  "%s declares locked_by(%r) but no call site resolves "
+                  "— the contract cannot be verified; acquire the lock "
+                  "in the function itself or keep a resolvable call "
+                  "shape" % (target.qual, lock))
+            continue
+        for caller, call in sites:
+            if _call_under_lock(call, lock):
+                continue
+            caller_contract = caller.contracts.get("locked_by")
+            if caller_contract is not None \
+                    and caller_contract.get("lock") == lock:
+                continue  # the caller's own call sites are checked
+            _emit(findings, caller.module.rel,
+                  getattr(call, "lineno", 1), "GC004",
+                  "call to %s (locked_by %r) from %s without holding "
+                  "the lock — every path into the mutator must hold %r"
+                  % (target.qual, lock, caller.qual, lock))
+
+
+# ---------------------------------------------------------------------------
+# GC005 — fused-body effect signatures
+# ---------------------------------------------------------------------------
+
+def _resolve_fused_bodies(graph: CallGraph,
+                          maker: FunctionInfo) -> List[FunctionInfo]:
+    bodies: List[FunctionInfo] = []
+    from .callgraph import own_nodes
+    for node in own_nodes(maker.node):
+        if not isinstance(node, ast.Call):
+            continue
+        for callee in graph._resolve_callee_expr(maker, node.func):
+            if callee.name == "_batch_iters" and node.args:
+                for b in graph._resolve_callee_expr(maker, node.args[0]):
+                    if b not in bodies:
+                        bodies.append(b)
+    if not bodies:
+        bodies = graph.returned_closures(maker)
+    return bodies
+
+
+def _body_consumes(body: FunctionInfo) -> Tuple[Set[str], List[str]]:
+    """(normalized input kinds, parameter names with no declared kind)."""
+    node = body.node
+    assert isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    names = [a.arg for a in (list(node.args.posonlyargs)
+                             + list(node.args.args)
+                             + list(node.args.kwonlyargs))]
+    if node.args.vararg is not None:
+        names.append(node.args.vararg.arg)
+    kinds: Set[str] = set()
+    unknown: List[str] = []
+    for n in names:
+        kind = CONSUME_KINDS.get(n)
+        if kind is None:
+            unknown.append(n)
+        else:
+            kinds.add(kind)
+    return kinds, unknown
+
+
+def check_fused_bodies(graph: CallGraph,
+                       findings: List[Finding]) -> None:
+    annotated = graph.contracted("fused_body")
+    have = {fn.qual for fn in annotated}
+    want = set(EXPECTED_FUSED_BODIES)
+    for qual in sorted(want - have):
+        rel = qual.split("::", 1)[0]
+        _emit(findings, rel, 1, "GC005",
+              "fused step maker %s is missing its @contract.fused_body "
+              "annotation (registry: contracts.EXPECTED_FUSED_BODIES — "
+              "a maker was removed or renamed without updating the "
+              "contract)" % qual)
+    for fn in annotated:
+        if fn.qual not in want:
+            _emit(findings, fn.module.rel,
+                  getattr(fn.node, "lineno", 1), "GC005",
+                  "%s carries @contract.fused_body but is not in "
+                  "contracts.EXPECTED_FUSED_BODIES — register it (the "
+                  "maker SET is part of the contract)" % fn.qual)
+
+    # uniformity of DECLARED collectives across all makers
+    declared_sets = {fn.qual: frozenset(
+        str(c) for c in fn.contracts["fused_body"].get(
+            "collectives", ()))                     # type: ignore[union-attr]
+        for fn in annotated}
+    if len(set(declared_sets.values())) > 1:
+        for fn in annotated:
+            _emit(findings, fn.module.rel,
+                  getattr(fn.node, "lineno", 1), "GC005",
+                  "%s declares collectives %s but the fused bodies must "
+                  "declare ONE uniform collective set (found %s across "
+                  "makers)"
+                  % (fn.qual, sorted(declared_sets[fn.qual]),
+                     sorted({tuple(sorted(s))
+                             for s in declared_sets.values()})))
+
+    core = set(FUSED_CORE)
+    for fn in annotated:
+        spec = fn.contracts["fused_body"]
+        declared_extras = {str(e) for e in spec.get("extras", ())}
+        declared_coll = {str(c) for c in spec.get("collectives", ())}
+        bodies = _resolve_fused_bodies(graph, fn)
+        if not bodies:
+            _emit(findings, fn.module.rel,
+                  getattr(fn.node, "lineno", 1), "GC005",
+                  "%s: could not resolve the fused step body through "
+                  "the call graph (the maker must build its body via "
+                  "_batch_iters or return a local closure)" % fn.qual)
+            continue
+        for body in bodies:
+            kinds, unknown = _body_consumes(body)
+            for name in unknown:
+                _emit(findings, body.module.rel,
+                      getattr(body.node, "lineno", 1), "GC005",
+                      "%s (body of %s) consumes parameter %r with no "
+                      "canonical input kind — extend "
+                      "contracts.CONSUME_KINDS deliberately or use a "
+                      "canonical name" % (body.qual, fn.qual, name))
+            missing = core - kinds
+            if missing:
+                _emit(findings, body.module.rel,
+                      getattr(body.node, "lineno", 1), "GC005",
+                      "%s (body of %s) does not consume the uniform "
+                      "core input(s) %s — all fused bodies share ONE "
+                      "effect signature (contracts.FUSED_CORE)"
+                      % (body.qual, fn.qual, sorted(missing)))
+            undeclared = (kinds - core) - declared_extras
+            if undeclared:
+                _emit(findings, body.module.rel,
+                      getattr(body.node, "lineno", 1), "GC005",
+                      "%s (body of %s) consumes extra input kind(s) %s "
+                      "not declared in @contract.fused_body(extras=...)"
+                      % (body.qual, fn.qual, sorted(undeclared)))
+            parent = graph.reach([body])
+            seen_coll: Set[str] = set()
+            for reached in parent:
+                seen_coll |= graph.effects(reached).collectives
+            if seen_coll != declared_coll:
+                _emit(findings, body.module.rel,
+                      getattr(body.node, "lineno", 1), "GC005",
+                      "%s (body of %s) transitively uses collectives %s "
+                      "but @contract.fused_body declares %s — the six "
+                      "bodies must keep one uniform collective "
+                      "signature"
+                      % (body.qual, fn.qual, sorted(seen_coll),
+                         sorted(declared_coll)))
+
+
+# ---------------------------------------------------------------------------
+# GC006 — counted flush discipline
+# ---------------------------------------------------------------------------
+
+def _in_counted_flush(fn: FunctionInfo) -> bool:
+    cur: Optional[FunctionInfo] = fn
+    while cur is not None:
+        if "counted_flush" in cur.contracts:
+            return True
+        cur = cur.parent
+    return False
+
+
+def check_counted_flush(graph: CallGraph,
+                        findings: List[Finding]) -> None:
+    for rel, mod in sorted(graph.modules.items()):
+        if rel.startswith("analysis/"):
+            continue  # guards.py IS the counter
+        for fn in mod.all_functions:
+            if _in_counted_flush(fn):
+                continue
+            for line in graph.effects(fn).device_gets:
+                _emit(findings, rel, line, "GC006",
+                      "jax.device_get in %s, outside any "
+                      "@contract.counted_flush function — deferred "
+                      "flushes must go through the counted wrapper so "
+                      "guards/bench transfer accounting stays honest"
+                      % fn.qual)
+
+
+# ---------------------------------------------------------------------------
+# GC007 — jax-free declarations
+# ---------------------------------------------------------------------------
+
+def check_declarations(graph: CallGraph,
+                       findings: List[Finding]) -> None:
+    from .contracts import EXPECTED_JAX_FREE
+    for rel, mod in sorted(graph.modules.items()):
+        top = rel.split("/", 1)[0] if "/" in rel else ""
+        if top in DECLARE_DIRS and mod.jax_free is None \
+                and rel not in EXPECTED_JAX_FREE:
+            _emit(findings, rel, 1, "GC007",
+                  "module under %s/ must declare `__jax_free__ = True` "
+                  "or `__jax_free__ = False` explicitly (new modules "
+                  "cannot silently escape the jax-free gate)" % top)
+    # the pinned set: the load-bearing fast-path modules must STAY
+    # declared jax-free — deleting or flipping the marker is a finding,
+    # not an escape hatch
+    for rel in EXPECTED_JAX_FREE:
+        mod = graph.modules.get(rel)
+        if mod is None:
+            continue  # module deleted/renamed: the import graph breaks
+        if mod.jax_free is not True:
+            _emit(findings, rel, 1, "GC007",
+                  "module is pinned jax-free by "
+                  "contracts.EXPECTED_JAX_FREE but does not declare "
+                  "`__jax_free__ = True` — the marker was removed or "
+                  "flipped without updating the registry")
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def run_graftcheck_graph(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, msg in graph.errors:
+        _emit(findings, rel, 1, "GC007", "unparseable module: %s" % msg)
+    check_traced_pure(graph, findings)
+    check_jax_free(graph, findings)
+    check_parity_oracles(graph, findings)
+    check_lock_discipline(graph, findings)
+    check_fused_bodies(graph, findings)
+    check_counted_flush(graph, findings)
+    check_declarations(graph, findings)
+    # stable order + dedup (one defect can surface through two roots)
+    uniq: Dict[Tuple[str, int, str, str], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.path, f.line, f.rule, f.message), f)
+    out = list(uniq.values())
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def run_graftcheck(root: Optional[str] = None,
+                   paths: Optional[Iterable[str]] = None
+                   ) -> List[Finding]:
+    """Analyze the package rooted at `root` (default: the installed
+    lightgbm_tpu).  `paths` optionally filters the REPORTED findings to
+    the given package-relative module paths; the analysis itself is
+    always whole-program (the rules are interprocedural)."""
+    graph = CallGraph.from_root(root)
+    findings = run_graftcheck_graph(graph)
+    if paths is not None:
+        keep = {p.replace("\\", "/") for p in paths}
+        findings = [f for f in findings if f.path in keep]
+    return findings
+
+
+def run_graftcheck_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Analyze an in-memory {relpath: source} package image (the
+    seeded-violation harness and unit tests)."""
+    return run_graftcheck_graph(CallGraph(sources))

@@ -76,6 +76,8 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+__jax_free__ = True
+
 RULES: Dict[str, str] = {
     "GL001": "host-sync-in-traced-fn",
     "GL002": "jax-import-in-jax-free-module",
@@ -90,6 +92,10 @@ RULES: Dict[str, str] = {
     "GL011": "static-bag-shape",
     "GL012": "host-sync-in-scan-carry",
 }
+
+# id -> human name for EVERY rule family that renders through Finding;
+# graftcheck registers its GC0xx whole-program rules here on import
+RULE_NAMES: Dict[str, str] = dict(RULES)
 
 # lax.scan-family transforms whose body argument is a scan body (GL012:
 # host syncs there serialize every batched iteration, not just one)
@@ -112,26 +118,57 @@ UNSUPPRESSABLE = {"GL009", "GL010"}
 # Module sets (paths relative to the package root, posix separators)
 # ---------------------------------------------------------------------------
 
-# Modules that must stay importable without jax anywhere in sys.modules:
-# the native task=predict fast path, CLI arg-parse, IO, the serving
-# fallback engine, and this analysis package itself.  At module level
-# they may import jax/jaxlib neither directly nor transitively (via a
-# package module outside this set); function-local imports are the
-# sanctioned lazy pattern.
-JAX_FREE_MODULES: Set[str] = {
-    "__init__.py", "__main__.py", "cli.py", "config.py",
-    "predict_fast.py",
-    "io/__init__.py", "io/parser.py", "io/binning.py", "io/dataset.py",
-    "models/__init__.py", "models/tree.py",
-    "native/__init__.py",
-    "parallel/__init__.py", "parallel/dist.py",
-    "serving/__init__.py", "serving/forest.py", "serving/batcher.py",
-    "serving/server.py",
-    "utils/__init__.py", "utils/log.py", "utils/mt19937.py",
-    "utils/compile_cache.py",
-    "analysis/__init__.py", "analysis/__main__.py",
-    "analysis/graftlint.py", "analysis/typegate.py", "analysis/guards.py",
-}
+# Modules that must stay importable without jax anywhere in sys.modules
+# (the native task=predict fast path, CLI arg-parse, IO, the serving
+# fallback engine, this analysis package itself) DECLARE themselves with
+# a module-level `__jax_free__ = True` marker — the set is DISCOVERED
+# per run (_discover_jax_free), not hard-coded, so a new serving/io
+# module cannot silently escape the gate (graftcheck GC007 additionally
+# requires an explicit declaration under contracts.DECLARE_DIRS).  At
+# module level a marked module may import jax/jaxlib neither directly
+# nor transitively (via a package module outside the marked set);
+# function-local imports are the sanctioned lazy pattern.
+_JAX_FREE_MARKER = "__jax_free__"
+# cheap pre-filter only — the authoritative check is the AST walk below
+# (a column-0 example line inside a docstring must NOT count)
+_MARKER_HINT_RE = re.compile(r"^__jax_free__", re.MULTILINE)
+
+
+def _tree_declares_jax_free(tree: ast.Module) -> Optional[bool]:
+    """The module's `__jax_free__` declaration from its AST (module
+    level, if/try blocks included like any import-time statement —
+    but NOT docstring text or function-local assignments)."""
+    stack: List[ast.stmt] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.If):
+            stack.extend(node.body)
+            stack.extend(node.orelse)
+        elif isinstance(node, ast.Try):
+            stack.extend(node.body)
+            stack.extend(node.orelse)
+            stack.extend(node.finalbody)
+            for h in node.handlers:
+                stack.extend(h.body)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == _JAX_FREE_MARKER \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, bool):
+                    return node.value.value
+    return None
+
+
+def _source_declares_jax_free(source: str) -> Optional[bool]:
+    """The module's own `__jax_free__` declaration, if any.  AST-based
+    (matching analysis/callgraph.py), with a regex pre-filter so the
+    package-wide discovery scan stays cheap."""
+    if _MARKER_HINT_RE.search(source) is None:
+        return None
+    try:
+        return _tree_declares_jax_free(ast.parse(source))
+    except SyntaxError:
+        return None
 
 # Modules whose output must be bit-reproducible against the reference
 # binary: no wall clock, no RNG outside utils/mt19937.
@@ -206,7 +243,7 @@ class Finding:
     def render(self) -> str:
         return "%s:%d: %s [%s] %s" % (
             self.path, self.line, self.rule,
-            RULES.get(self.rule, "typing"), self.message)
+            RULE_NAMES.get(self.rule, "typing"), self.message)
 
 
 @dataclasses.dataclass
@@ -749,8 +786,17 @@ class ModuleLint:
                         % (fn.name, p.arg))
 
     # -- GL002 ----------------------------------------------------------
+    def _declares_jax_free(self) -> bool:
+        """This module's own declaration wins; otherwise the discovered
+        package-wide marker set (so lint_source() of an in-memory
+        module at a real path sees the installed module's contract)."""
+        own = _tree_declares_jax_free(self.tree)
+        if own is not None:
+            return own
+        return self.rel in _JAX_FREE
+
     def check_jax_free(self) -> None:
-        if self.rel not in JAX_FREE_MODULES:
+        if not self._declares_jax_free():
             return
         pkg_dir = os.path.dirname(self.rel)  # "" for top-level modules
         pkg_name = os.path.basename(package_root())
@@ -786,12 +832,13 @@ class ModuleLint:
             for cand in mods:
                 for suffix in (cand + ".py", cand + "/__init__.py"):
                     if suffix in _ALL_MODULES:
-                        if suffix not in JAX_FREE_MODULES:
+                        if suffix not in _JAX_FREE:
                             bad.append(suffix)
                         break
             return bad
 
-        def module_level_stmts(body):
+        def module_level_stmts(
+                body: Sequence[ast.stmt]) -> Iterable[ast.stmt]:
             """Module-level statements, descending into `if` blocks (a
             conditionally-guarded import still executes at import time)
             — except TYPE_CHECKING blocks, which never run."""
@@ -799,6 +846,9 @@ class ModuleLint:
                 if isinstance(node, ast.If):
                     test = _dotted(node.test)
                     if test in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+                        # the guarded body never runs — but its ELSE
+                        # branch runs in every real process
+                        yield from module_level_stmts(node.orelse)
                         continue
                     yield from module_level_stmts(node.body)
                     yield from module_level_stmts(node.orelse)
@@ -907,6 +957,18 @@ class ModuleLint:
                 cur = getattr(cur, "_gl_parent", None)
             return False
 
+        def has_locked_by_contract(fn: ast.AST) -> bool:
+            """@contract.locked_by("...") moves the proof obligation to
+            graftcheck GC004: every call path into the function must
+            hold the named lock, so per-line suppressions inside it are
+            no longer needed (or wanted)."""
+            for dec in getattr(fn, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = _dotted(target) or ""
+                if dotted.endswith("contract.locked_by"):
+                    return True
+            return False
+
         def self_attr_target(t: ast.AST) -> Optional[str]:
             """'a.b.c' when the store target is an attribute chain (or
             a subscript of one — `self.requests[k] = ...` mutates the
@@ -929,6 +991,8 @@ class ModuleLint:
             if fn is None or isinstance(fn, ast.Lambda):
                 continue
             if fn.name in ("__init__", "__init_subclass__", "__new__"):
+                continue
+            if has_locked_by_contract(fn):
                 continue
             targets: List[ast.AST] = []
             if isinstance(n, ast.Assign):
@@ -1006,7 +1070,21 @@ class ModuleLint:
                 return i + 1
         return None
 
+    def _decorator_def_lines(self) -> Dict[int, int]:
+        """Line of each decorator -> line of the def/class it adorns: a
+        suppression comment written ABOVE a decorator must still bind
+        to the def (findings anchor on the def line, not the decorator
+        line)."""
+        out: Dict[int, int] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node.decorator_list:
+                for dec in node.decorator_list:
+                    out[dec.lineno] = node.lineno
+        return out
+
     def _apply_suppressions(self) -> List[Finding]:
+        dec_to_def = self._decorator_def_lines()
         by_line: Dict[int, List[Suppression]] = {}
         for s in self.suppressions:
             by_line.setdefault(s.line, []).append(s)
@@ -1014,6 +1092,11 @@ class ModuleLint:
                 target = self._next_code_line(s.line)
                 if target is not None:
                     by_line.setdefault(target, []).append(s)
+                    # comment above a decorated def: the next code line
+                    # is the decorator, but the finding sits on the def
+                    def_line = dec_to_def.get(target)
+                    if def_line is not None:
+                        by_line.setdefault(def_line, []).append(s)
         kept: List[Finding] = []
         for f in self.findings:
             hit = None
@@ -1049,8 +1132,40 @@ class ModuleLint:
 
 
 # populated per run: every module path in the package (for GL002's
-# transitive resolution)
+# transitive resolution) and the subset declaring __jax_free__ = True
 _ALL_MODULES: Set[str] = set()
+_JAX_FREE: Set[str] = set()
+
+# memoized package index per root: lint_source() is called ~100 times
+# per test run and must not re-read + re-parse the whole package each
+# time.  run_graftlint() always refreshes (it reads the files anyway).
+_INDEX_CACHE: Dict[str, Tuple[Set[str], Set[str]]] = {}
+
+
+def _package_index(root: str) -> Tuple[Set[str], Set[str]]:
+    got = _INDEX_CACHE.get(root)
+    if got is None:
+        mods = {os.path.relpath(p, root).replace(os.sep, "/")
+                for p in iter_package_files(root)}
+        got = (mods, _discover_jax_free(root))
+        _INDEX_CACHE[root] = got
+    return got
+
+
+def _discover_jax_free(root: str) -> Set[str]:
+    """Package-relative paths of every module declaring
+    `__jax_free__ = True` under `root`."""
+    out: Set[str] = set()
+    for path in iter_package_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        if _source_declares_jax_free(src):
+            out.add(rel)
+    return out
 
 
 def package_root() -> str:
@@ -1073,10 +1188,12 @@ def run_graftlint(paths: Optional[Sequence[str]] = None,
     package rooted at `root` (default: the installed lightgbm_tpu)."""
     root = root or package_root()
     files = list(paths) if paths else iter_package_files(root)
-    global _ALL_MODULES
+    global _ALL_MODULES, _JAX_FREE
     _ALL_MODULES = {
         os.path.relpath(p, root).replace(os.sep, "/")
         for p in iter_package_files(root)}
+    _JAX_FREE = _discover_jax_free(root)
+    _INDEX_CACHE[root] = (_ALL_MODULES, _JAX_FREE)  # refresh the memo
     findings: List[Finding] = []
     for path in files:
         rel = os.path.relpath(path, root).replace(os.sep, "/")
@@ -1102,13 +1219,11 @@ def run_graftlint(paths: Optional[Sequence[str]] = None,
 def lint_source(source: str, relpath: str) -> List[Finding]:
     """Lint one in-memory module as if it lived at `relpath` inside the
     package (test helper)."""
-    global _ALL_MODULES
-    saved = _ALL_MODULES
+    global _ALL_MODULES, _JAX_FREE
+    saved, saved_free = _ALL_MODULES, _JAX_FREE
     try:
         if not _ALL_MODULES:
-            _ALL_MODULES = {
-                os.path.relpath(p, package_root()).replace(os.sep, "/")
-                for p in iter_package_files(package_root())}
+            _ALL_MODULES, _JAX_FREE = _package_index(package_root())
         return ModuleLint(relpath, source, relpath).run()
     finally:
-        _ALL_MODULES = saved
+        _ALL_MODULES, _JAX_FREE = saved, saved_free

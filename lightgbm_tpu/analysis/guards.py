@@ -52,6 +52,8 @@ is atomic under the GIL.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import contextlib
 import dataclasses
 import logging

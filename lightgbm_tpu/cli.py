@@ -9,6 +9,8 @@ unchanged.  The Network::Init socket bootstrap is replaced by the JAX mesh
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import os
 import sys
 import time

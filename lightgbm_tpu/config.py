@@ -15,6 +15,8 @@ Config; they control the JAX mesh instead of the socket/MPI bootstrap.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 

@@ -1,1 +1,3 @@
 """lightgbm_tpu.io"""
+
+__jax_free__ = True

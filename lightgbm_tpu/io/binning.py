@@ -14,6 +14,8 @@ filter (reference src/io/dataset_loader.cpp:585).
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import dataclasses
 from typing import List
 

@@ -22,6 +22,8 @@ src/io/dataset_loader.cpp, src/io/metadata.cpp):
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import dataclasses
 import os
 from typing import List, Optional

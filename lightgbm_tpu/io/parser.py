@@ -10,6 +10,8 @@ ingest plugs in behind the same interface.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import re
 from typing import List, Optional, Tuple
 

@@ -1,1 +1,3 @@
 """lightgbm_tpu.models"""
+
+__jax_free__ = True

@@ -14,6 +14,8 @@ parity tests with bagging enabled.
 
 from __future__ import annotations
 
+__jax_free__ = False  # the boosting driver traces jits
+
 from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
@@ -21,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..config import Config
 from ..io.dataset import Dataset
 from ..metrics import Metric
@@ -149,6 +152,9 @@ _SCAN_DART = (((0, 0), (1, 1), (2, 2), (3, 3), (4, 4), (5, 5), (15, 8)),
               (6, 7, 8, 9, 11, 16), (6, 7), 9)
 
 
+@contract.parity_oracle("K=1 returns the body UNCHANGED — the "
+                        "per-iteration oracle executes the very same "
+                        "closure, so K>1 is bit-parity by construction")
 def _batch_iters(body, spec, k):
     """Wrap a fused step body in an outer lax.scan over `k` boosting
     iterations.  k == 1 returns the body unchanged — the per-iteration
@@ -198,6 +204,9 @@ def dispatch_count() -> int:
     return _DISPATCHES
 
 
+@contract.traced_pure
+@contract.parity_oracle("the plain fused body: bag_compact=off / "
+                        "masked-bagging oracle (PARITY.md §2.3)")
 def _fused_step_body(grad_fn, grow_kw, lr, dtype, compact_rows=0):
     def step(scores, valid_scores, bag_mask, fmask, bins, valid_bins,
              gstate, stopped):
@@ -227,6 +236,9 @@ def _fused_step_body(grad_fn, grow_kw, lr, dtype, compact_rows=0):
     return step
 
 
+@contract.traced_pure
+@contract.fused_body(collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step(grad_fn, grow_kw, lr, dtype, compact_rows=0,
                      k_iters=1):
     body = _batch_iters(_fused_step_body(grad_fn, grow_kw, lr, dtype,
@@ -235,6 +247,7 @@ def _make_fused_step(grad_fn, grow_kw, lr, dtype, compact_rows=0,
     return jax.jit(body, donate_argnums=(0, 1))
 
 
+@contract.traced_pure
 def _permute_window_rows(rel_w, m, n, bufs):
     """Window-local re-sort of row-major buffers (rows on the LAST
     axis) under bag compaction: gather positions [:m] by rel_w and keep
@@ -249,6 +262,7 @@ def _permute_window_rows(rel_w, m, n, bufs):
     return rel, out
 
 
+@contract.traced_pure
 def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
                              permute_state=None, compact_rows=0):
     """The fused step PLUS the ordered-partition row re-sort: after the
@@ -315,6 +329,10 @@ def _fused_step_body_reorder(grad_fn, grow_kw, lr, dtype,
     return step
 
 
+@contract.traced_pure
+@contract.fused_body(extras=("order",),
+                     collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step_reorder(grad_fn, grow_kw, lr, dtype,
                              permute_state=None, compact_rows=0,
                              k_iters=1):
@@ -340,6 +358,10 @@ def _dart_layout(L):
     return SF0, TB0, LC0, RC0, RC1, LV0, LV1
 
 
+@contract.traced_pure
+@contract.fused_body(extras=("bank", "dart"),
+                     collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves,
                           compact_rows=0, k_iters=1):
     """Fused DART iteration over a DEVICE-RESIDENT tree bank (VERDICT r3
@@ -467,6 +489,7 @@ def _make_fused_step_dart(grad_fn, grow_kw, dtype, max_leaves,
                    donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
+@contract.traced_pure
 def _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder=False,
                            permute_state=None, compact_rows=0):
     """Fused MULTICLASS iteration (VERDICT r3 #4): gradients for all K
@@ -563,6 +586,10 @@ def _fused_step_multi_body(grad_fn, grow_kw, lr, dtype, reorder=False,
     return step
 
 
+@contract.traced_pure
+@contract.fused_body(extras=("order",),
+                     collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False,
                            permute_state=None, compact_rows=0, k_iters=1):
     # gstate is NOT donated: on the first re-sort it aliases the
@@ -576,6 +603,10 @@ def _make_fused_step_multi(grad_fn, grow_kw, lr, dtype, reorder=False,
                    donate_argnums=(0, 1, 2, 4, 8) if reorder else (0, 1))
 
 
+@contract.traced_pure
+@contract.fused_body(extras=("order",),
+                     collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
                                    n_valid, gstate_specs, reorder,
                                    permute_state=None, compact_rows=0,
@@ -618,6 +649,10 @@ def _make_fused_step_multi_sharded(grad_fn, grow_kw, lr, dtype, mesh,
     return jax.jit(fn, donate_argnums=donate)
 
 
+@contract.traced_pure
+@contract.fused_body(extras=("order",),
+                     collectives=("all_gather", "axis_index", "pmax",
+                                  "psum", "psum_scatter"))
 def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
                              n_valid, gstate_specs, reorder,
                              permute_state=None, compact_rows=0,
@@ -663,6 +698,7 @@ def _make_fused_step_sharded(grad_fn, grow_kw, lr, dtype, mesh,
     return jax.jit(fn, donate_argnums=donate)
 
 
+@contract.traced_pure
 def _bag_arrange_body(permute_state, multi):
     """In-bag-first stable arrangement of every per-row device buffer —
     the bag-compaction boundary step, ONE dispatch per re-bagging.  The
@@ -1859,6 +1895,9 @@ class GBDT:
         return [_PendingTree(ints[j], floats[j], lr, gated=True)
                 for j in range(k_iters)]
 
+    @contract.parity_oracle("the general per-tree path: one grow "
+                            "dispatch per tree — the oracle every fused "
+                            "path is parity-tested against (PARITY.md)")
     def _train_tree(self, grad, hess, bag_mask_dev, fmask, cls):
         cfg = self.config
         _note_dispatch()   # the general path: one grow dispatch per tree
@@ -1934,6 +1973,7 @@ class GBDT:
     def models(self, value) -> None:
         self._models = list(value)
 
+    @contract.counted_flush
     def _flush_pending(self) -> bool:
         """Unpack pending device trees; truncate at the first 1-leaf stump
         (the reference stops training there, gbdt.cpp:186).  Deleted trees

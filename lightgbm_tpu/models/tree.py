@@ -9,6 +9,8 @@ Text format is byte-compatible with Tree::ToString / Tree::Tree(str)
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import dataclasses
 from typing import List
 

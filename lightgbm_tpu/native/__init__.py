@@ -11,6 +11,8 @@ Set LGBM_TPU_NO_NATIVE=1 to force the pure-Python fallbacks (io/parser.py).
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import ctypes
 import os
 import subprocess

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import native
+from .analysis.contracts import contract
 from .config import Config
 from .io.dataset import Metadata
 from .utils import log
@@ -98,6 +99,7 @@ class Objective:
         identically."""
         raise NotImplementedError
 
+    @contract.traced_pure
     def make_permute_fn(self):
         """-> pure fn (grad_state, rel) -> grad_state permuted to the
         new row order (new position j holds old row rel[j]).  Traced
@@ -186,6 +188,7 @@ class RegressionL2(Objective):
         return (self.label, self.weights)
 
     @staticmethod
+    @contract.traced_pure
     def make_grad_fn():
         def grad_fn(score, state):
             label, weights = state
@@ -249,6 +252,7 @@ class BinaryLogloss(Objective):
     def grad_state(self):
         return (self.sign, self.label_weight)
 
+    @contract.traced_pure
     def make_grad_fn(self):
         sig = jnp.float32(self.sigmoid)
 
@@ -307,6 +311,7 @@ class MulticlassSoftmax(Objective):
         return (self.onehot, self.weights)
 
     @staticmethod
+    @contract.traced_pure
     def make_grad_fn():
         def grad_fn(score, state):
             """score [K, N] -> grad/hess [K, N].
@@ -506,6 +511,7 @@ class LambdarankNDCG(Objective):
     def grad_state(self):
         return self._dev_state
 
+    @contract.traced_pure
     def make_permute_fn(self):
         """Row permutation support (ordered-partition mode): row_slot is
         per-row and rides the permutation; doc_idx holds row POSITIONS
@@ -625,6 +631,7 @@ class LambdarankNDCG(Objective):
             row_slot[s] = row_slot[s][rel]
         return (di, lab, gain, inv, wts, row_slot.reshape(-1), disc)
 
+    @contract.traced_pure
     def make_grad_fn(self):
         sigmoid = float(self.sigmoid)
 

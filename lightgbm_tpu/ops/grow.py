@@ -35,6 +35,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
 from .histogram import leaf_histogram, make_gvals
 from .predict import predict_leaf_binned
 from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
@@ -138,6 +139,11 @@ def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
     return jax.tree_util.tree_map(lambda a: a[win], gathered)
 
 
+@contract.traced_pure
+@contract.parity_oracle("the growth kernel under full-length masked "
+                        "bagging: bag_rows<=0 falls through here — the "
+                        "bit-parity oracle bag compaction is tested "
+                        "against (PARITY.md §2.3)")
 @functools.partial(
     jax.jit,
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
@@ -611,6 +617,7 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     return final.tree, final.leaf_id
 
 
+@contract.traced_pure
 def grow_tree_bagged(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                      bag_mask: jax.Array, feature_mask: jax.Array, *,
                      bag_rows: int = 0, **grow_kw):

@@ -1,1 +1,3 @@
 """lightgbm_tpu.parallel"""
+
+__jax_free__ = True

@@ -16,6 +16,8 @@ process_allgather (jax.experimental.multihost_utils).
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import socket
 from typing import List, Optional, Tuple
 

@@ -32,6 +32,8 @@ replicated outputs from loop-carried computations.
 
 from __future__ import annotations
 
+__jax_free__ = False  # device mesh layer: jax by design
+
 import dataclasses
 import functools
 

@@ -25,10 +25,13 @@ unavailable so cli.Application falls back to the JAX path.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .analysis.contracts import contract
 from .config import Config
 from .io.parser import sniff_format
 from .models.tree import Tree, parse_model_text
@@ -151,9 +154,14 @@ def _sniff_format(path: str, has_header: bool) -> Tuple[str, str]:
         return sniff_format(lambda: f.read(SNIFF_BYTES), has_header)
 
 
+@contract.jax_free
 def try_fast_predict(cfg: Config) -> bool:
     """Run task=predict through the native path; False -> caller falls
-    back to the default JAX path (native toolchain unavailable)."""
+    back to the default JAX path (native toolchain unavailable).
+
+    @contract.jax_free: the whole point of this path is the reference
+    binary's process-startup profile — graftcheck GC002 verifies
+    nothing it transitively calls imports jax, even lazily."""
     from . import native
     if native.get_lib() is None:
         return False

@@ -19,6 +19,8 @@ predictor.hpp:82-130); this package is that loop turned into a service:
 Selected by `task=serve` through the CLI (cli.py / config.py).
 """
 
+__jax_free__ = True
+
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # pragma: no cover - annotation-only re-exports

@@ -18,11 +18,15 @@ pre-swap forest during a hot reload — never share a dispatch.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+from ..analysis.contracts import contract
 
 
 class RowsPayload:
@@ -148,9 +152,11 @@ class MicroBatcher:
         return [it.result for it in items]
 
     # -- worker side -----------------------------------------------------
+    @contract.locked_by("_cv")
     def _take_batch(self) -> List[_Item]:
-        """Called with the lock held; returns the next dispatch (blocks
-        through the batching window) or [] at shutdown."""
+        """Called with the lock held (graftcheck GC004 verifies every
+        call site); returns the next dispatch (blocks through the
+        batching window) or [] at shutdown."""
         while not self._queue:
             if self._stopped:
                 return []
@@ -168,15 +174,10 @@ class MicroBatcher:
                 else:
                     rest.append(it)
             if rows >= self.max_batch_rows or self._stopped:
-                # graftlint: disable=GL006 -- _take_batch's contract is
-                # "called with self._cv held" (the _loop call site); the
-                # lock cannot appear lexically here
                 self._queue = rest
                 return batch
             wait = deadline - time.monotonic()
             if wait <= 0:
-                # graftlint: disable=GL006 -- same _cv-held contract as
-                # the dispatch-full branch above (see _loop's with block)
                 self._queue = rest
                 return batch
             self._cv.wait(wait)

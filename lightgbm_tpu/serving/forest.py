@@ -27,12 +27,15 @@ otherwise (identical for finite doubles).
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..models.tree import Tree, parse_model_text
 from ..utils import log
 
@@ -144,6 +147,7 @@ class ServingForest:
                 self._jax_pack = {"dev": dev, "lv": lv}
         return self._jax_pack
 
+    @contract.jax_free
     def _build_host_pack(self) -> Dict[str, Any]:
         if self._host_pack is not None:
             return self._host_pack
@@ -153,8 +157,13 @@ class ServingForest:
                 self._host_pack = {"lv": lv}
         return self._host_pack
 
+    @contract.jax_free
     def _native_forest(self) -> Optional[Any]:
-        """native.ForestSpec for the fused text kernel, or None."""
+        """native.ForestSpec for the fused text kernel, or None.
+
+        @contract.jax_free: this is the serving fallback engine —
+        graftcheck GC002 verifies the native spec build cannot pull
+        jax into a backend=native server process."""
         if not self._native_spec_tried:
             with self._lock:
                 if not self._native_spec_tried:

@@ -27,6 +27,8 @@ coalesced dispatch).
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import json
 import signal
 import threading
@@ -37,6 +39,7 @@ from urllib.parse import ParseResult, parse_qs, urlparse
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..config import Config
 from ..io.parser import parse_predict_rows, sniff_format
 from ..utils import log
@@ -62,20 +65,16 @@ class _Histogram:
         self.counts = [0] * (len(self.buckets) + 1)   # +inf tail
         self.sum = 0.0
 
+    @contract.locked_by("_lock")
     def observe(self, v: float) -> None:
-        # graftlint: disable=GL006 -- _Histogram is an internal of
-        # Metrics: every observe()/render() call site holds
-        # Metrics._lock (the threaded test_serving_metrics_locking
-        # regression hammers this)
+        # _Histogram is an internal of Metrics: graftcheck GC004
+        # verifies every observe() call site holds Metrics._lock (the
+        # threaded test_serving_metrics_locking regression hammers it)
         self.sum += v
         for i, b in enumerate(self.buckets):
             if v <= b:
-                # graftlint: disable=GL006 -- same Metrics._lock-held
-                # contract as the sum update above
                 self.counts[i] += 1
                 return
-        # graftlint: disable=GL006 -- same Metrics._lock-held contract
-        # as the sum update above
         self.counts[-1] += 1
 
     def render(self, name: str, help_: str, out: List[str]) -> None:

@@ -1,1 +1,3 @@
 """lightgbm_tpu.utils"""
+
+__jax_free__ = True

@@ -15,6 +15,8 @@ out with LGBM_TPU_NO_COMPILE_CACHE=1 (LIGHTGBM_TPU_NO_CACHE=1 also
 accepted); override the location with LIGHTGBM_TPU_CACHE_DIR.
 """
 
+__jax_free__ = True
+
 import os
 
 _enabled = False

@@ -8,6 +8,8 @@ reference CLI is possible.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import sys
 from typing import NoReturn
 

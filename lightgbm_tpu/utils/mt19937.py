@@ -14,6 +14,8 @@ mt19937).  Blocks of 624 outputs are generated vectorised with numpy.
 
 from __future__ import annotations
 
+__jax_free__ = True
+
 import numpy as np
 
 _N = 624

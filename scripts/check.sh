@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# scripts/check.sh — THE single pre-merge check entry point.
+#
+#   1. scripts/lint.sh        graftlint + graftcheck + typegate (always;
+#                             stdlib-only), ruff/mypy when installed,
+#                             baseline-gated (analysis/baseline.json)
+#   2. repo-is-clean pytest gates:
+#        tests/test_graftlint.py             rule power + repo clean sweep
+#        tests/test_graftcheck.py            call graph + contract rules
+#        tests/test_graftcheck_mutations.py  seeded-violation harness:
+#                                            every contract class catches
+#                                            its bug class, clean tree
+#                                            stays clean
+#
+# Exit codes:
+#   0  everything that ran is clean
+#   1  findings / test failures
+#   2  a tool crashed (treat as failure, not as clean)
+#
+# Full tier-1 (slow, needs jax) stays `python -m pytest tests/ -m "not
+# slow"` — this script is the fast gate that runs everywhere, including
+# jax-free lanes.
+
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+bash scripts/lint.sh
+l=$?
+if [ "$l" -ge 2 ]; then
+    echo "check.sh: lint.sh crashed (exit $l)" >&2
+    exit 2
+fi
+[ "$l" -ne 0 ] && rc=1
+
+echo "== repo-is-clean pytest gates (graftlint + graftcheck + mutations) =="
+if command -v python >/dev/null 2>&1 && python -c "import pytest" 2>/dev/null; then
+    python -m pytest tests/test_graftlint.py tests/test_graftcheck.py \
+        tests/test_graftcheck_mutations.py -q -p no:cacheprovider
+    p=$?
+    if [ "$p" -ge 2 ]; then
+        echo "check.sh: pytest crashed (exit $p)" >&2
+        exit 2
+    fi
+    [ "$p" -ne 0 ] && rc=1
+else
+    echo "== pytest: not installed — SKIPPED (lint.sh covered the stdlib gates) =="
+fi
+
+if [ "$rc" -eq 0 ]; then
+    echo "check.sh: clean"
+else
+    echo "check.sh: FINDINGS (exit 1)" >&2
+fi
+exit $rc

@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
-# scripts/lint.sh — single static-analysis entry point for CI and humans.
+# scripts/lint.sh — static-analysis entry point for CI and humans.
+# (scripts/check.sh wraps this plus the repo-clean pytest gates.)
 #
-#   graftlint + typegate   always (stdlib-only, python -m lightgbm_tpu.analysis)
+#   graftlint + graftcheck + typegate   always (stdlib-only,
+#       python -m lightgbm_tpu.analysis, gated against the checked-in
+#       analysis/baseline.json so only NEW findings fail)
 #   ruff                   when installed ([tool.ruff] in pyproject.toml)
 #   mypy --strict gate     when installed ([tool.mypy] in pyproject.toml)
 #
@@ -19,8 +22,8 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== graftlint + typing gate (python -m lightgbm_tpu.analysis) =="
-python -m lightgbm_tpu.analysis
+echo "== graftlint + graftcheck + typing gate (python -m lightgbm_tpu.analysis) =="
+python -m lightgbm_tpu.analysis --baseline lightgbm_tpu/analysis/baseline.json
 g=$?
 if [ "$g" -ge 2 ]; then
     echo "lint.sh: graftlint crashed (exit $g)" >&2

@@ -1,0 +1,566 @@
+"""graftcheck engine tests: call-graph resolution corner cases,
+interprocedural depth, lock discipline, the contract registries, and
+the `python -m lightgbm_tpu.analysis` exit-code/baseline contract.
+
+Everything here is stdlib-only (the analyzer never imports jax); the
+synthetic package images go through run_graftcheck_sources, the same
+entry the seeded-violation harness uses.
+
+The two depth tests pin the ISSUE's acceptance bar explicitly:
+  * a host sync TWO calls below a traced entry point is caught
+    (test_host_sync_two_calls_deep);
+  * a transitive jax import TWO hops below a jax-free module is caught
+    (test_jax_import_two_hops_deep).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lightgbm_tpu.analysis.callgraph import CallGraph
+from lightgbm_tpu.analysis.graftcheck import (run_graftcheck,
+                                              run_graftcheck_sources)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def synth(**modules):
+    """{name: dedented source} -> sources dict with a package root."""
+    out = {"__init__.py": ""}
+    for name, src in modules.items():
+        out[name.replace("__", "/") + ".py"] = textwrap.dedent(src)
+    return out
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural depth (the acceptance bar)
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralDepth:
+    def test_host_sync_two_calls_deep(self):
+        """entry -> helper1 -> helper2 -> np.asarray: the sync is two
+        calls below the traced entry point and still caught, with the
+        full chain in the message."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                from .b import helper1
+
+                @contract.traced_pure
+                def entry(x):
+                    return helper1(x)
+            """,
+            b="""
+                from .c import helper2
+
+                def helper1(x):
+                    return helper2(x)
+            """,
+            c="""
+                import numpy as np
+
+                def helper2(x):
+                    return np.asarray(x)
+            """))
+        hits = by_rule(fs, "GC001")
+        assert len(hits) == 1
+        f = hits[0]
+        assert f.path == "c.py"
+        assert "np.asarray" in f.message
+        assert ("a.py::entry -> b.py::helper1 -> c.py::helper2"
+                in f.message)
+
+    def test_clean_chain_no_finding(self):
+        fs = run_graftcheck_sources(synth(
+            a="""
+                from .b import helper1
+
+                @contract.traced_pure
+                def entry(x):
+                    return helper1(x)
+            """,
+            b="""
+                def helper1(x):
+                    return x + 1
+            """))
+        assert by_rule(fs, "GC001") == []
+
+    def test_host_sync_via_returned_closure(self):
+        """Factory roots cover the closures they return."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                @contract.traced_pure
+                def make_step(k):
+                    def step(x):
+                        return x.item() + k
+                    return step
+            """))
+        hits = by_rule(fs, "GC001")
+        assert len(hits) == 1
+        assert ".item()" in hits[0].message
+
+    def test_jax_import_two_hops_deep(self):
+        """jf -> mid -> deep(import jax): two import hops below the
+        __jax_free__ marker and still caught, chain included."""
+        fs = run_graftcheck_sources(synth(
+            jf="""
+                __jax_free__ = True
+                from . import mid
+            """,
+            mid="""
+                from . import deep
+            """,
+            deep="""
+                import jax
+            """))
+        hits = [f for f in by_rule(fs, "GC002") if f.path == "jf.py"]
+        assert len(hits) == 1
+        assert "jf.py -> mid.py -> deep.py" in hits[0].message
+
+    def test_jax_free_chain_clean(self):
+        fs = run_graftcheck_sources(synth(
+            jf="""
+                __jax_free__ = True
+                from . import mid
+            """,
+            mid="""
+                import numpy as np
+            """))
+        assert by_rule(fs, "GC002") == []
+
+    def test_lazy_jax_import_through_call_closure(self):
+        """@contract.jax_free covers function-level reach: a lazy
+        `import jax` in a callee's callee is caught."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                from .b import load
+
+                @contract.jax_free
+                def fast_path(x):
+                    return load(x)
+            """,
+            b="""
+                def load(x):
+                    return _backend(x)
+
+                def _backend(x):
+                    import jax
+                    return jax.numpy.asarray(x)
+            """))
+        hits = by_rule(fs, "GC002")
+        assert len(hits) == 1
+        assert hits[0].path == "b.py"
+        assert "a.py::fast_path -> b.py::load -> b.py::_backend" \
+            in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# Call-graph corner cases
+# ---------------------------------------------------------------------------
+
+class TestCallGraphCornerCases:
+    def test_functools_partial_wrapped_body(self):
+        """A body passed through functools.partial into a higher-order
+        call is still an edge — the sync inside it is caught."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                import functools
+                import jax
+
+                @contract.traced_pure
+                def entry(xs):
+                    def body(k, carry, x):
+                        return carry + x.item() * k, None
+                    return jax.lax.scan(functools.partial(body, 3),
+                                        0.0, xs)
+            """))
+        hits = by_rule(fs, "GC001")
+        assert len(hits) == 1
+        assert ".item()" in hits[0].message
+
+    def test_method_resolution_through_self(self):
+        """self.meth() and self.attr.meth() both bind; the lock rule
+        sees through them."""
+        fs = run_graftcheck_sources(synth(
+            serving__thing="""
+                __jax_free__ = True
+                import threading
+
+                class Inner:
+                    @contract.locked_by("_lock")
+                    def bump(self):
+                        self.n = self.n + 1
+
+                class Outer:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.inner = Inner()
+
+                    def locked_entry(self):
+                        with self._lock:
+                            self.inner.bump()
+
+                    def unlocked_entry(self):
+                        self.inner.bump()
+            """))
+        hits = by_rule(fs, "GC004")
+        assert len(hits) == 1
+        assert "unlocked_entry" in hits[0].message
+        assert "bump" in hits[0].message
+
+    def test_inherited_method_and_super_resolution(self):
+        """super().flush() binds to the base method; the counted_flush
+        sanction does NOT leak to a subclass override's own syncs."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                import jax
+
+                class Base:
+                    @contract.counted_flush
+                    def flush(self):
+                        return jax.device_get(self.buf)
+
+                class Child(Base):
+                    def flush(self):
+                        out = super().flush()
+                        extra = jax.device_get(self.extra)
+                        return out, extra
+            """))
+        hits = by_rule(fs, "GC006")
+        assert len(hits) == 1
+        assert "Child.flush" in hits[0].message
+
+    def test_reexport_through_package_init(self):
+        """`from <pkg> import Thing` resolves through the package
+        __init__'s _EXPORTS lazy dict to the defining module."""
+        sources = synth(
+            impl="""
+                class Thing:
+                    def __init__(self):
+                        self.x = 1
+            """,
+            user="""
+                from lightgbm_tpu import Thing
+
+                def build():
+                    return Thing()
+            """)
+        sources["__init__.py"] = textwrap.dedent("""
+            _EXPORTS = {"Thing": ".impl"}
+
+            def __getattr__(name):
+                import importlib
+                return getattr(importlib.import_module(
+                    _EXPORTS[name], __name__), name)
+        """)
+        graph = CallGraph(sources)
+        user = graph.modules["user.py"].functions["build"]
+        callees = [e.callee.qual for e in graph.callees(user)]
+        assert "impl.py::Thing.__init__" in callees
+
+    def test_decorated_def_still_binds(self):
+        """Decorators never hide a def from resolution (the fused
+        makers are decorated with @contract.* and @functools.partial
+        chains in the real tree)."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                import functools
+                import jax
+
+                def other_deco(f):
+                    return f
+
+                @contract.traced_pure
+                @other_deco
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def kernel(x, k):
+                    return x.item() + k
+            """))
+        hits = by_rule(fs, "GC001")
+        assert len(hits) == 1
+
+
+# ---------------------------------------------------------------------------
+# Lock discipline specifics
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_self_acquiring_mutator_is_fine(self):
+        fs = run_graftcheck_sources(synth(
+            serving__m="""
+                __jax_free__ = True
+                import threading
+
+                class M:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    @contract.locked_by("_lock")
+                    def bump(self):
+                        with self._lock:
+                            self.n = self.n + 1
+
+                def drive(m):
+                    m.bump()
+            """))
+        assert by_rule(fs, "GC004") == []
+
+    def test_contract_propagates_through_same_lock_caller(self):
+        """A locked_by caller of a locked_by mutator is not a finding —
+        its OWN call sites carry the obligation instead."""
+        fs = run_graftcheck_sources(synth(
+            serving__m="""
+                __jax_free__ = True
+                import threading
+
+                class M:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    @contract.locked_by("_cv")
+                    def _inner(self):
+                        self.q = []
+
+                    @contract.locked_by("_cv")
+                    def _outer(self):
+                        self._inner()
+
+                    def loop(self):
+                        with self._cv:
+                            self._outer()
+            """))
+        assert by_rule(fs, "GC004") == []
+
+
+# ---------------------------------------------------------------------------
+# Registries + the real tree
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def real_graph():
+    return CallGraph.from_root()
+
+
+class TestRealTree:
+    def test_repo_is_clean(self, real_graph):
+        """The tier-1 gate: zero whole-program contract findings on the
+        real package."""
+        from lightgbm_tpu.analysis.graftcheck import run_graftcheck_graph
+        assert run_graftcheck_graph(real_graph) == []
+
+    def test_all_six_fused_bodies_annotated(self, real_graph):
+        from lightgbm_tpu.analysis.contracts import EXPECTED_FUSED_BODIES
+        have = {fn.qual for fn in real_graph.contracted("fused_body")}
+        assert have == set(EXPECTED_FUSED_BODIES)
+        assert len(have) == 6
+
+    def test_fused_bodies_resolve(self, real_graph):
+        from lightgbm_tpu.analysis.graftcheck import _resolve_fused_bodies
+        for maker in real_graph.contracted("fused_body"):
+            bodies = _resolve_fused_bodies(real_graph, maker)
+            assert bodies, "no body resolved for %s" % maker.qual
+
+    def test_parity_oracles_annotated(self, real_graph):
+        from lightgbm_tpu.analysis.contracts import (
+            EXPECTED_PARITY_ORACLES)
+        have = {fn.qual for fn in real_graph.contracted("parity_oracle")}
+        assert have == set(EXPECTED_PARITY_ORACLES)
+
+    def test_locked_by_sites_resolve(self, real_graph):
+        """The GC004 proof is only as strong as the call-site
+        resolution — pin that the real mutators' call sites are seen."""
+        for fn in real_graph.contracted("locked_by"):
+            assert real_graph.call_sites_of(fn), \
+                "no call sites resolved for %s" % fn.qual
+
+    def test_scoped_paths_filter_findings(self):
+        # whole-program analysis, scoped report: a clean tree stays
+        # empty under any scope
+        assert run_graftcheck(paths=["models/gbdt.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes, --json, --baseline
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis"] + args,
+        cwd=cwd, capture_output=True, text=True, timeout=300)
+
+
+@pytest.mark.slow
+class TestCliContractSlow:
+    def test_clean_tree_exits_zero(self):
+        r = _run_cli(["--baseline",
+                      "lightgbm_tpu/analysis/baseline.json"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout
+
+
+class TestCliContract:
+    def test_findings_exit_one_and_json(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(:\n")  # syntax error -> GL009 finding
+        r = _run_cli(["--json", "--no-graftcheck", "--no-typegate",
+                      str(bad)])
+        assert r.returncode == 1, r.stdout + r.stderr
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        objs = [json.loads(ln) for ln in lines]
+        assert objs and objs[0]["rule"] == "GL009"
+        assert {"path", "line", "rule", "message"} <= set(objs[0])
+
+    def test_baseline_suppresses_known_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(:\n")
+        r = _run_cli(["--json", "--no-graftcheck", "--no-typegate",
+                      str(bad)])
+        assert r.returncode == 1
+        entries = [json.loads(ln)
+                   for ln in r.stdout.splitlines() if ln.strip()]
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps(
+            [{"path": e["path"], "rule": e["rule"],
+              "message": e["message"]} for e in entries]))
+        r2 = _run_cli(["--baseline", str(baseline), "--no-graftcheck",
+                       "--no-typegate", str(bad)])
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    def test_crash_exits_two(self, tmp_path):
+        r = _run_cli(["--baseline", str(tmp_path / "missing.json"),
+                      "--no-graftcheck", "--no-typegate"])
+        assert r.returncode == 2
+
+    def test_unknown_option_exits_two(self):
+        r = _run_cli(["--definitely-not-an-option"])
+        assert r.returncode == 2
+
+
+class TestLockDisciplineFallback:
+    def test_unresolvable_call_shape_still_checked_same_module(self):
+        """A dict-iteration call the resolver cannot bind must not
+        escape the contract: same-module name-matched attribute calls
+        are held to the lock too."""
+        fs = run_graftcheck_sources(synth(
+            serving__m="""
+                __jax_free__ = True
+                import threading
+
+                class Hist:
+                    @contract.locked_by("_lock")
+                    def observe(self, v):
+                        self.total += v
+
+                class Metrics:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.hists = {}
+
+                    def locked_sweep(self, v):
+                        with self._lock:
+                            for h in self.hists.values():
+                                h.observe(v)
+
+                    def unlocked_sweep(self, v):
+                        for h in self.hists.values():
+                            h.observe(v)
+            """))
+        hits = by_rule(fs, "GC004")
+        assert len(hits) == 1
+        assert "unlocked_sweep" in hits[0].message
+
+    def test_unverifiable_contract_is_a_finding(self):
+        """locked_by with no resolvable call site at all cannot be
+        proven — that is itself a finding, not a silent pass."""
+        fs = run_graftcheck_sources(synth(
+            serving__m="""
+                __jax_free__ = True
+
+                class Hist:
+                    @contract.locked_by("_lock")
+                    def bump(self):
+                        self.n += 1
+            """))
+        hits = by_rule(fs, "GC004")
+        assert len(hits) == 1
+        assert "cannot be verified" in hits[0].message
+
+
+class TestBaselineNormalization:
+    def test_norm_path_strips_package_prefix(self):
+        from lightgbm_tpu.analysis.__main__ import _norm_path
+        assert _norm_path("lightgbm_tpu/utils/log.py") == "utils/log.py"
+        assert _norm_path("utils/log.py") == "utils/log.py"
+        assert _norm_path(
+            "../somewhere/lightgbm_tpu/serving/server.py") \
+            == "serving/server.py"
+        assert _norm_path("/tmp/other/bad.py") == "/tmp/other/bad.py"
+
+
+class TestJaxFreeHardening:
+    def test_type_checking_else_branch_in_import_graph(self):
+        fs = run_graftcheck_sources(synth(
+            jf="""
+                __jax_free__ = True
+                from . import mid
+            """,
+            mid="""
+                from typing import TYPE_CHECKING
+                if TYPE_CHECKING:
+                    pass
+                else:
+                    import jax
+            """))
+        hits = [f for f in by_rule(fs, "GC002") if f.path == "jf.py"]
+        assert len(hits) == 1
+
+    def test_pinned_module_cannot_flip_marker(self, real_graph):
+        """EXPECTED_JAX_FREE pins the old hard-coded list: every entry
+        exists and declares True on the real tree."""
+        from lightgbm_tpu.analysis.contracts import EXPECTED_JAX_FREE
+        for rel in EXPECTED_JAX_FREE:
+            mod = real_graph.modules.get(rel)
+            assert mod is not None, "%s pinned but missing" % rel
+            assert mod.jax_free is True, \
+                "%s pinned jax-free but not declared" % rel
+
+    def test_cross_module_unresolvable_call_checked(self):
+        """The GC004 name fallback is package-wide: an unlocked call on
+        a PASSED-IN object in another module is still held to the
+        lock."""
+        fs = run_graftcheck_sources(synth(
+            serving__hist="""
+                __jax_free__ = True
+
+                class Hist:
+                    @contract.locked_by("_lock")
+                    def observe(self, v):
+                        self.total += v
+
+                class Owner:
+                    def __init__(self):
+                        import threading
+                        self._lock = threading.Lock()
+                        self.h = Hist()
+
+                    def locked_use(self):
+                        with self._lock:
+                            self.h.observe(1.0)
+            """,
+            serving__sweeper="""
+                __jax_free__ = True
+
+                def sweep(hists):
+                    for h in hists:
+                        h.observe(0.0)
+            """))
+        hits = by_rule(fs, "GC004")
+        assert len(hits) == 1
+        assert hits[0].path == "serving/sweeper.py"

@@ -1,0 +1,84 @@
+"""The seeded-violation harness: rule POWER, not just rule existence.
+
+analysis/mutations.py holds >= 2 deliberate contract violations per
+contract class, applied as source transforms to in-memory copies of the
+REAL package modules.  This harness asserts
+
+  * the unmutated tree analyzes clean (the analyzer does not cry wolf),
+  * every mutation still parses (the violations are semantic, the
+    analysis is static),
+  * every mutation is flagged by its expected rule, anchored on the
+    expected module, with the expected evidence in the message,
+  * every contract class is covered by at least two mutations.
+
+A transform whose source anchor drifted raises AssertionError from
+apply_mutation — a refactor that invalidates a seeded violation fails
+HERE instead of silently shrinking the proof corpus.
+"""
+
+import ast
+
+import pytest
+
+from lightgbm_tpu.analysis.graftcheck import run_graftcheck_sources
+from lightgbm_tpu.analysis.mutations import (MUTATIONS, apply_mutation,
+                                             base_sources,
+                                             contract_classes)
+
+
+@pytest.fixture(scope="module")
+def base():
+    return base_sources()
+
+
+def test_clean_tree_analyzes_clean(base):
+    findings = run_graftcheck_sources(base)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("mutation", MUTATIONS,
+                         ids=[m.name for m in MUTATIONS])
+def test_mutation_is_flagged(base, mutation):
+    mutated = apply_mutation(base, mutation)
+    # the violation must be SEMANTIC: the mutated module still parses
+    ast.parse(mutated[mutation.module], filename=mutation.module)
+    findings = run_graftcheck_sources(mutated)
+    hits = [f for f in findings
+            if f.rule == mutation.expect_rule
+            and f.path == mutation.expect_path
+            and mutation.expect_substr in f.message]
+    assert hits, (
+        "mutation %r (%s) not flagged: wanted rule=%s path=%s "
+        "substr=%r, got:\n%s"
+        % (mutation.name, mutation.description, mutation.expect_rule,
+           mutation.expect_path, mutation.expect_substr,
+           "\n".join(f.render() for f in findings) or "  (no findings)"))
+
+
+def test_every_contract_class_has_two_mutations():
+    classes = contract_classes()
+    assert set(classes) == {"traced_pure", "jax_free", "parity_oracle",
+                            "locked_by", "fused_body", "counted_flush"}
+    for cls in classes:
+        n = sum(1 for m in MUTATIONS if m.contract == cls)
+        assert n >= 2, "contract class %r has %d mutation(s), want >= 2" \
+            % (cls, n)
+
+
+def test_mutations_are_distinct(base):
+    """Each mutation changes exactly its declared module, all
+    differently (no duplicate seeds masking each other)."""
+    seen = set()
+    for m in MUTATIONS:
+        mutated = apply_mutation(base, m)
+        changed = [rel for rel in mutated if mutated[rel] != base[rel]]
+        assert changed == [m.module]
+        key = (m.module, mutated[m.module])
+        assert key not in seen, "duplicate mutation %s" % m.name
+        seen.add(key)
+
+
+def test_anchor_drift_raises():
+    from lightgbm_tpu.analysis.mutations import _replace_once
+    with pytest.raises(AssertionError, match="anchor drifted"):
+        _replace_once("x = 1\n", "not-there", "y", what="drift test")

@@ -735,3 +735,144 @@ def test_repo_is_graftlint_clean():
 def test_repo_passes_typegate():
     findings = run_typegate()
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# GL002 discovery: the jax-free set comes from __jax_free__ markers
+# ---------------------------------------------------------------------------
+
+def test_marker_declares_module_jax_free():
+    out = lint("""
+        __jax_free__ = True
+        import jax
+    """, relpath="some/new_module.py")
+    assert "GL002" in rules_of(out)
+
+
+def test_unmarked_module_not_gated():
+    out = lint("""
+        import jax
+    """, relpath="some/new_module.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_own_false_marker_overrides_discovered_set():
+    # predict_fast.py is marker-discovered jax-free in the real tree;
+    # an explicit False declaration in the source under lint wins
+    out = lint("""
+        __jax_free__ = False
+        import jax
+    """, relpath="predict_fast.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_discovered_set_covers_real_modules():
+    out = lint("""
+        import jax
+    """, relpath="predict_fast.py")
+    assert "GL002" in rules_of(out)
+
+
+def test_import_of_non_jax_free_module_still_flagged():
+    out = lint("""
+        __jax_free__ = True
+        from .models.gbdt import GBDT
+    """, relpath="somemod.py")
+    assert "GL002" in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# GL006 x @contract.locked_by: the obligation moves to graftcheck GC004
+# ---------------------------------------------------------------------------
+
+def test_locked_by_contract_exempts_gl006():
+    out = lint("""
+        from ..analysis.contracts import contract
+
+        class Hist:
+            @contract.locked_by("_lock")
+            def observe(self, v):
+                self.total += v
+    """, relpath="serving/metrics_like.py")
+    assert "GL006" not in rules_of(out)
+
+
+def test_uncontracted_serving_store_still_flagged():
+    out = lint("""
+        class Hist:
+            def observe(self, v):
+                self.total += v
+    """, relpath="serving/metrics_like.py")
+    assert "GL006" in rules_of(out)
+
+
+# ---------------------------------------------------------------------------
+# suppression binding across decorators
+# ---------------------------------------------------------------------------
+
+def test_suppression_above_decorator_binds_to_def():
+    out = lint("""
+        import jax
+
+        # graftlint: disable=GL004 -- test fixture retraces per mode on
+        # purpose; two modes total, bounded by the driver
+        @jax.jit
+        def f(x, mode: str = "a"):
+            return x
+    """)
+    assert "GL004" not in rules_of(out)
+    assert "GL010" not in rules_of(out)  # and the suppression is not stale
+
+
+def test_suppression_above_multiline_decorator_binds_to_def():
+    out = lint("""
+        import functools
+        import jax
+
+        # graftlint: disable=GL004 -- test fixture retraces per mode on
+        # purpose; two modes total, bounded by the driver
+        @functools.partial(jax.jit,
+                           donate_argnums=(0,))
+        def f(x, mode: str = "a"):
+            return x
+    """)
+    assert "GL004" not in rules_of(out)
+
+
+def test_suppression_on_decorated_def_without_comment_still_fires():
+    out = lint("""
+        import jax
+
+        @jax.jit
+        def f(x, mode: str = "a"):
+            return x
+    """)
+    assert "GL004" in rules_of(out)
+
+
+def test_marker_inside_docstring_does_not_count():
+    # a column-0 example line inside a docstring is TEXT, not a
+    # declaration (the marker is read from the AST, not by regex)
+    out = lint('''
+        """Example of the convention:
+
+        __jax_free__ = True
+        """
+        import jax
+    ''', relpath="some/new_module.py")
+    assert "GL002" not in rules_of(out)
+
+
+def test_type_checking_else_branch_still_gated():
+    # `if TYPE_CHECKING: ... else: import jax` imports jax in every
+    # REAL process — the else branch must not ride the guard's exemption
+    out = lint("""
+        __jax_free__ = True
+        from typing import TYPE_CHECKING
+
+        if TYPE_CHECKING:
+            import jax
+        else:
+            import jax
+    """, relpath="some/new_module.py")
+    assert "GL002" in rules_of(out)
