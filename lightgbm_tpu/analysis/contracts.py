@@ -62,6 +62,14 @@ Contract classes (checking rules live in graftcheck.py):
       under-count when a new code path materializes device buffers.
       Rule GC006.
 
+  @contract.durable_write
+      This function is a sanctioned durable-artifact writer: binary
+      writes (`open(.., "wb"/"ab")`, np.savez) are only legal inside a
+      function carrying this contract — everything else must route
+      through resilience/atomic.py (tmp + fsync + os.replace + sha256
+      footer), because a bare binary write crash-truncates in place
+      and poisons every later run.  Rule GC008.
+
 Module marker — jax-free modules declare themselves:
 
     __jax_free__ = True     # module + its import closure never pull jax
@@ -93,7 +101,8 @@ JAX_FREE_MARKER = "__jax_free__"
 #: one of these trees is a finding until its author states the import
 #: contract one way or the other.
 DECLARE_DIRS: Tuple[str, ...] = ("serving", "io", "utils", "analysis",
-                                 "native", "parallel", "models")
+                                 "native", "parallel", "models",
+                                 "resilience")
 
 #: modules PINNED jax-free: these must declare `__jax_free__ = True` —
 #: deleting the marker (or flipping it to False) is a finding (GC007),
@@ -112,6 +121,11 @@ EXPECTED_JAX_FREE: Tuple[str, ...] = (
     "serving/server.py",
     "utils/__init__.py", "utils/log.py", "utils/mt19937.py",
     "utils/compile_cache.py",
+    # the fault-tolerance layer rides inside the jax-free fast paths
+    # (predict_fast results, serving fallback, CLI snapshot cadence)
+    "resilience/__init__.py", "resilience/atomic.py",
+    "resilience/faults.py", "resilience/net.py",
+    "resilience/snapshot.py",
 )
 
 # ---------------------------------------------------------------------------
@@ -233,6 +247,10 @@ class _Contract:
     @staticmethod
     def counted_flush(fn: F) -> F:
         return _tag(fn, "counted_flush", {})
+
+    @staticmethod
+    def durable_write(fn: F) -> F:
+        return _tag(fn, "durable_write", {})
 
 
 contract = _Contract()

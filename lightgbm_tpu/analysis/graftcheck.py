@@ -47,6 +47,13 @@ boundary; these rules cross it:
         A module under contracts.DECLARE_DIRS with no explicit
         `__jax_free__ = True/False` declaration — new serving/io/utils
         modules must state their import contract to enter the tree.
+  GC008 unsanctioned-durable-write
+        A binary write (`open(.., "wb"/"ab"/..)` or np.savez/np.save)
+        outside a @contract.durable_write function: durable artifacts
+        must route through resilience/atomic.py (tmp + fsync +
+        os.replace + sha256 footer) — a bare binary write truncates in
+        place when the process dies mid-write, and a truncated
+        cache/snapshot/model poisons every later run.
 
 Entry points: run_graftcheck() for the installed package (or an
 explicit root), run_graftcheck_sources() for an in-memory
@@ -58,7 +65,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .callgraph import CallGraph, FunctionInfo, _lockish_name
+from .callgraph import CallGraph, FunctionInfo, _dotted, _lockish_name
 from .contracts import (CONSUME_KINDS, DECLARE_DIRS,
                         EXPECTED_FUSED_BODIES, EXPECTED_PARITY_ORACLES,
                         FUSED_CORE)
@@ -74,6 +81,7 @@ CHECK_RULES: Dict[str, str] = {
     "GC005": "fused-body-contract",
     "GC006": "uncounted-device-flush",
     "GC007": "jax-free-undeclared",
+    "GC008": "unsanctioned-durable-write",
 }
 RULE_NAMES.update(CHECK_RULES)
 
@@ -406,6 +414,113 @@ def check_counted_flush(graph: CallGraph,
 
 
 # ---------------------------------------------------------------------------
+# GC008 — durable-write discipline
+# ---------------------------------------------------------------------------
+
+_NP_SAVERS = ("np.savez", "numpy.savez", "np.savez_compressed",
+              "numpy.savez_compressed", "np.save", "numpy.save")
+
+
+def _durable_write_call(node: ast.AST) -> Optional[str]:
+    """What kind of bare binary write this Call is, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        mode: Optional[str] = None
+        if len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        if mode and "b" in mode \
+                and any(c in mode for c in ("w", "a", "x", "+")):
+            return "open(.., %r)" % mode
+        return None
+    dotted = _dotted(f)
+    if dotted in _NP_SAVERS:
+        return dotted
+    return None
+
+
+def _in_durable_write(fn: FunctionInfo) -> bool:
+    cur: Optional[FunctionInfo] = fn
+    while cur is not None:
+        if "durable_write" in cur.contracts:
+            return True
+        cur = cur.parent
+    return False
+
+
+def check_durable_writes(graph: CallGraph,
+                         findings: List[Finding]) -> None:
+    from .callgraph import own_nodes
+    for rel, mod in sorted(graph.modules.items()):
+        for fn in mod.all_functions:
+            if _in_durable_write(fn):
+                continue
+            for node in own_nodes(fn.node):
+                what = _durable_write_call(node)
+                if what is not None:
+                    _emit(findings, rel,
+                          getattr(node, "lineno", 1), "GC008",
+                          "%s in %s is a bare binary write to a "
+                          "durable artifact — route it through "
+                          "resilience/atomic.py (atomic_writer / "
+                          "write_npz) or contract the function "
+                          "@contract.durable_write" % (what, fn.qual))
+        # module-level writes (rare, but a cache warm at import time
+        # must not escape the rule): walk import-time statements —
+        # function bodies are statements of their own and were
+        # excluded at collection, so this covers exactly the rest
+        for stmt in _module_level_write_stmts(mod.tree):
+            for node in _walk_skip_contracted(stmt):
+                what = _durable_write_call(node)
+                if what is not None:
+                    _emit(findings, rel,
+                          getattr(node, "lineno", 1), "GC008",
+                          "%s at module level is a bare binary write "
+                          "to a durable artifact — route it through "
+                          "resilience/atomic.py" % what)
+
+
+def _walk_skip_contracted(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """ast.walk, except a function def nested inside a module-level
+    compound statement (an `if`/`try` import shim) keeps its own
+    contract: callgraph._collect_defs does not collect such defs, so
+    this walk must honor an explicit @contract.durable_write on them
+    instead of flagging the body as a module-level write."""
+    from .callgraph import _contract_of_decorator
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parsed = (_contract_of_decorator(d)
+                      for d in node.decorator_list)
+            if any(p is not None and p[0] == "durable_write"
+                   for p in parsed):
+                continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _module_level_write_stmts(tree: ast.Module) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            out.append(node)
+        elif isinstance(node, ast.ClassDef):
+            out.extend(s for s in node.body
+                       if not isinstance(s, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # GC007 — jax-free declarations
 # ---------------------------------------------------------------------------
 
@@ -449,6 +564,7 @@ def run_graftcheck_graph(graph: CallGraph) -> List[Finding]:
     check_lock_discipline(graph, findings)
     check_fused_bodies(graph, findings)
     check_counted_flush(graph, findings)
+    check_durable_writes(graph, findings)
     check_declarations(graph, findings)
     # stable order + dedup (one defect can surface through two roots)
     uniq: Dict[Tuple[str, int, str, str], Finding] = {}

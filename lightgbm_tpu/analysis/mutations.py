@@ -232,6 +232,28 @@ def _t_remove_counted_flush(src: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# durable_write — binary writes that dodge the atomic helper
+# ---------------------------------------------------------------------------
+
+def _t_bare_checkpoint_write(src: str) -> str:
+    return _insert_after(
+        src, "        write_npz(path, arrays)\n",
+        "        with open(path + '.bak', 'wb') as f:"
+        "  # seeded violation\n"
+        "            np.savez(f, **arrays)\n",
+        what="bare open('wb') checkpoint write into save_checkpoint")
+
+
+def _t_bare_sidecar_savez(src: str) -> str:
+    return _insert_before(
+        src, "def _rank_cache_matches(",
+        "def _mirror_sidecar(path, ds):  # seeded violation\n"
+        "    np.savez(path + '.rows.bak.npz', rows=ds.local_rows)\n"
+        "\n\n",
+        what="bare np.savez sidecar mirror into io/dataset.py")
+
+
+# ---------------------------------------------------------------------------
 # The corpus
 # ---------------------------------------------------------------------------
 
@@ -320,6 +342,18 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "removing the counted_flush annotation — the flush's own "
        "device_get immediately loses its sanction",
        _t_remove_counted_flush),
+
+    _m("bare-checkpoint-write", "durable_write", "models/gbdt.py",
+       "GC008", "models/gbdt.py", "open(.., 'wb')",
+       "a bare open('wb') checkpoint copy next to the atomic write — "
+       "a crash mid-write truncates it in place and poisons the next "
+       "resume",
+       _t_bare_checkpoint_write),
+    _m("bare-sidecar-savez", "durable_write", "io/dataset.py",
+       "GC008", "io/dataset.py", "np.savez",
+       "a bare np.savez of the rows sidecar outside the atomic helper "
+       "— a truncated sidecar desyncs the cluster's row partition",
+       _t_bare_sidecar_savez),
 )
 
 

@@ -432,6 +432,12 @@ class Booster:
             self._gbdt.load_model_from_string(text)
             self._train_metrics = []
             self._valid_names = []
+        if self.config.faults:
+            # deterministic fault injection: the API path honors the
+            # same `faults` config key as cli.Application (config wins
+            # over the LGBM_TPU_FAULTS environment schedule)
+            from .resilience.faults import configure
+            configure(self.config.faults)
 
     # -- training ------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> None:
@@ -631,7 +637,17 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     gbdt.config.metric_freq = freq if freq > 0 else (1 << 30)
     early = gbdt.early_stopping_round > 0
     is_eval = freq > 0 or early
+    # crash-safe snapshots + auto-resume (resilience/snapshot.py): the
+    # API loop honors the same snapshot_period / snapshot_dir / resume
+    # keys as cli.train, riding save_checkpoint's bit-exact state
+    from .resilience.snapshot import SnapshotManager
+    # cap = the LOOP's bound, not config num_iterations: a snapshot
+    # past num_boost_round would skip the loop and return extra trees
+    snaps = SnapshotManager.from_config(gbdt.config,
+                                        max_iteration=num_boost_round)
     done = 0
+    if snaps is not None:
+        done = snaps.maybe_resume(gbdt)
     stop = False
     while done < num_boost_round and not stop:
         if fobj is not None:
@@ -648,4 +664,6 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             stop, k = gbdt.train_segment(num_boost_round - done,
                                          is_eval=is_eval)
             done += k
+        if snaps is not None and snaps.due(done):
+            snaps.write(gbdt)
     return booster

@@ -12,9 +12,10 @@ from __future__ import annotations
 __jax_free__ = True
 
 import os
+import signal
 import sys
 import time
-from typing import List, Optional, TYPE_CHECKING
+from typing import Any, List, Optional, TYPE_CHECKING
 
 import numpy as np
 
@@ -38,6 +39,11 @@ class Application:
     def __init__(self, argv: List[str]):
         params = config_mod.load_parameters(argv)
         self.config = Config.from_params(params)
+        if self.config.faults:
+            # deterministic fault injection (chaos testing): config key
+            # wins over the LGBM_TPU_FAULTS environment schedule
+            from .resilience.faults import configure
+            configure(self.config.faults)
 
     def _apply_device_type(self) -> None:
         if self.config.device_type == "cpu":
@@ -171,13 +177,17 @@ class Application:
         for vd, ms in zip(self.valid_datas, self.valid_metricss):
             self.boosting.add_valid_data(vd, ms)
         if self.num_machines > 1:
-            from .parallel.dist import process_allgather
-
-            def stop_sync(b: bool) -> bool:
-                votes = process_allgather(np.array([int(b)], dtype=np.int64))
-                return bool(votes.sum() > 0)
-
-            self.boosting.stop_sync = stop_sync
+            from .parallel.dist import vote_any
+            self.boosting.stop_sync = vote_any
+        # crash-safe snapshots + auto-resume (resilience/snapshot.py):
+        # the manager rides save_checkpoint's bit-exact state; resume
+        # must run AFTER the booster has its datasets/valid sets so the
+        # restored state lands in the exact structures training uses
+        from .resilience.snapshot import SnapshotManager
+        self.snapshots = SnapshotManager.from_config(
+            cfg, self.rank, self.num_machines)
+        if self.snapshots is not None:
+            self.snapshots.maybe_resume(self.boosting)
         log.info("Finished initializing training")
 
     def _set_init_scores(self, ds, fname: str) -> None:
@@ -206,27 +216,74 @@ class Application:
         from .models.gbdt import NO_LIMIT
 
         cfg = self.config
+        snaps = self.snapshots
         log.info("Started training...")
         start = time.time()
         is_finished = False
-        it = 0
-        # iteration-batched segments (config.iter_batch): the booster
-        # scans K iterations per device dispatch and surfaces control
-        # only at metric / early-stop / re-bagging boundaries.  Metric
-        # lines and the final model are identical to the per-iteration
-        # loop's; the incremental-save cadence and the elapsed-seconds
-        # log timestamps become per-SEGMENT (up to K iterations between
-        # appends — iter_batch=1 restores the per-iteration cadence)
-        while it < cfg.num_iterations and not is_finished:
-            is_finished, done = self.boosting.train_segment(
-                cfg.num_iterations - it)
-            for j in range(done):
-                log.info("%f seconds elapsed, finished iteration %d"
-                         % (time.time() - start, it + j + 1))
-            it += done
-            self.boosting.save_model_to_file(NO_LIMIT, is_finished,
+        # resume=auto restored the booster mid-run: continue counting
+        # from ITS iteration (0 on a fresh start)
+        it = int(self.boosting.iter)
+        # graceful preemption: SIGTERM converts to "snapshot at the next
+        # segment boundary, then exit cleanly" — a preemptible pool
+        # loses at most one segment, not the job.  Handler installed
+        # only while training (and only on the main thread).
+        preempted = {"flag": False}
+
+        def _on_term(signum: int, frame: Any) -> None:
+            preempted["flag"] = True
+            log.info("SIGTERM: snapshotting at the next segment "
+                     "boundary, then exiting")
+
+        prev_term: Any = None
+        if snaps is not None and snaps.period > 0:
+            try:
+                prev_term = signal.signal(signal.SIGTERM, _on_term)
+            except ValueError:   # not on the main thread (embedded use)
+                prev_term = None
+        try:
+            # iteration-batched segments (config.iter_batch): the booster
+            # scans K iterations per device dispatch and surfaces control
+            # only at metric / early-stop / re-bagging boundaries.  Metric
+            # lines and the final model are identical to the per-iteration
+            # loop's; the incremental-save cadence and the elapsed-seconds
+            # log timestamps become per-SEGMENT (up to K iterations between
+            # appends — iter_batch=1 restores the per-iteration cadence)
+            while it < cfg.num_iterations and not is_finished:
+                is_finished, done = self.boosting.train_segment(
+                    cfg.num_iterations - it)
+                for j in range(done):
+                    log.info("%f seconds elapsed, finished iteration %d"
+                             % (time.time() - start, it + j + 1))
+                it += done
+                stop_now = preempted["flag"]
+                if snaps is not None and snaps.period > 0 \
+                        and self.num_machines > 1:
+                    # one rank's SIGTERM stops EVERY rank at the same
+                    # boundary.  Gated on period > 0 — the same
+                    # fingerprint-synced config condition that installs
+                    # the SIGTERM handler, so the collective runs
+                    # symmetrically on all ranks and a resume-only
+                    # manager (period=0) pays no per-segment allgather
+                    stop_now = snaps.sync_flag(stop_now)
+                if stop_now:
+                    snaps.write(self.boosting)
+                    # the incremental model save is mid-stream: drop
+                    # its tmp (the resume run rewrites the model from
+                    # the snapshot; an orphan would accumulate per
+                    # preemption)
+                    self.boosting.abort_model_save()
+                    log.info("Preempted at iteration %d: snapshot "
+                             "flushed, exiting cleanly" % it)
+                    return
+                self.boosting.save_model_to_file(NO_LIMIT, is_finished,
+                                                 cfg.output_model)
+                if snaps is not None and snaps.due(it):
+                    snaps.write(self.boosting)
+            self.boosting.save_model_to_file(NO_LIMIT, True,
                                              cfg.output_model)
-        self.boosting.save_model_to_file(NO_LIMIT, True, cfg.output_model)
+        finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
         log.info("Finished training")
 
     # ------------------------------------------------------------------
@@ -328,12 +385,16 @@ class Application:
             return format_pred_rows(res, False)
 
         gen = blocks()
-        # pull the first block BEFORE opening (truncating) the output file
-        # so an empty input fatals without clobbering a previous result
+        # pull the first block BEFORE opening the output so an empty
+        # input fatals without clobbering a previous result; the atomic
+        # writer extends that guarantee to EVERY failure (a crash
+        # mid-stream leaves the previous complete result, never a
+        # truncated one — the tmp is replaced only on success)
         first = next(gen, None)
         if first is None:
             log.fatal("Data file %s is empty" % cfg.data)
-        with open(cfg.output_result, "wb") as out_f, \
+        from .resilience.atomic import atomic_writer
+        with atomic_writer(cfg.output_result) as out_f, \
                 ThreadPoolExecutor(max_workers=1) as ex:
             pending = ex.submit(parse, first)
             for lines in gen:

@@ -229,6 +229,37 @@ class Config:
     serve_max_batch_rows: int = 8192      # rows per coalesced dispatch
     serve_batch_timeout_ms: float = 2.0   # micro-batching window
     serve_backend: str = "auto"           # auto | jax | native
+    serve_max_inflight_rows: int = 65536  # admission control: rows in
+    #                                       flight before new requests
+    #                                       get a fast 503 + Retry-After
+    #                                       instead of unbounded queueing
+    serve_breaker_threshold: int = 3      # consecutive device-dispatch
+    #                                       failures before the circuit
+    #                                       breaker pins serving to the
+    #                                       JAX-free native predictor
+    serve_retry_after_s: float = 1.0      # Retry-After on overload 503s
+
+    # -- fault tolerance (resilience/) -----------------------------------
+    snapshot_period: int = 0              # snapshot every N iterations
+    #                                       (0 = off); requires
+    #                                       snapshot_dir
+    snapshot_dir: str = ""                # where snapshots live
+    snapshot_keep: int = 4                # newest snapshots retained per
+    #                                       rank (0 = keep everything)
+    resume: str = "off"                   # off | auto | <snapshot path>:
+    #                                       auto picks the latest VALID
+    #                                       snapshot in snapshot_dir,
+    #                                       skipping corrupt ones
+    faults: str = ""                      # fault-injection schedule
+    #                                       (resilience/faults.py; also
+    #                                       env LGBM_TPU_FAULTS)
+    dist_connect_deadline_s: float = 120.0  # overall deadline for the
+    #                                         distributed-runtime connect
+    #                                         retry loop
+    dist_timeout_s: float = 600.0         # per-collective deadline; a
+    #                                       dead peer raises NetworkError
+    #                                       instead of hanging (0 = wait
+    #                                       forever)
 
     # ---------------------------------------------------------------------
     @staticmethod
@@ -379,6 +410,16 @@ class Config:
         set_int("serve_max_batch_rows")
         set_float("serve_batch_timeout_ms")
         set_str("serve_backend")
+        set_int("serve_max_inflight_rows")
+        set_int("serve_breaker_threshold")
+        set_float("serve_retry_after_s")
+        set_int("snapshot_period")
+        set_str("snapshot_dir")
+        set_int("snapshot_keep")
+        set_str("resume")
+        set_str("faults")
+        set_float("dist_connect_deadline_s")
+        set_float("dist_timeout_s")
         if c.serve_backend not in ("auto", "jax", "native"):
             log.fatal("Unknown serve_backend %s (expect auto|jax|native)"
                       % c.serve_backend)
@@ -386,6 +427,20 @@ class Config:
             log.fatal("serve_max_batch_rows must be >= 1")
         if c.serve_batch_timeout_ms < 0:
             log.fatal("serve_batch_timeout_ms must be >= 0")
+        if c.serve_max_inflight_rows < 1:
+            log.fatal("serve_max_inflight_rows must be >= 1")
+        if c.serve_breaker_threshold < 1:
+            log.fatal("serve_breaker_threshold must be >= 1")
+        if c.serve_retry_after_s < 0:
+            log.fatal("serve_retry_after_s must be >= 0")
+        if c.snapshot_period < 0:
+            log.fatal("snapshot_period must be >= 0")
+        if c.snapshot_keep < 0:
+            log.fatal("snapshot_keep must be >= 0")
+        if c.snapshot_period > 0 and not c.snapshot_dir:
+            log.fatal("snapshot_period requires snapshot_dir")
+        if c.resume == "auto" and not c.snapshot_dir:
+            log.fatal("resume=auto requires snapshot_dir")
         if c.device_type not in ("", "cpu", "tpu"):
             log.fatal("Unknown device_type %s (expect cpu|tpu)"
                       % c.device_type)

@@ -30,6 +30,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..resilience.atomic import (IntegrityError, atomic_writer, read_npz,
+                                 verify_file, write_npz)
 from ..utils import log
 from ..utils.mt19937 import Mt19937Random
 from ..config import Config
@@ -740,12 +742,15 @@ def _save_binary_cache(ds: Dataset, filename: str, config: Config,
     path = _rank_cache_path(filename, rank, num_shards)
     _save_binary(ds, path, config.num_class)
     if num_shards > 1 and ds.local_rows is not None:
-        with open(path + ".rows.npz", "wb") as f:
-            np.savez(f, rows=ds.local_rows,
-                     n_global=np.int64(n_global),
-                     seed=np.int64(config.data_random_seed),
-                     query_lottery=np.int64(
-                         ds.metadata.query_boundaries is not None))
+        # atomic + checksummed (resilience/atomic): a crash mid-write
+        # must never leave a truncated sidecar that desyncs the
+        # cluster's row partition on the next run
+        write_npz(path + ".rows.npz",
+                  dict(rows=ds.local_rows,
+                       n_global=np.int64(n_global),
+                       seed=np.int64(config.data_random_seed),
+                       query_lottery=np.int64(
+                           ds.metadata.query_boundaries is not None)))
 
 
 def _rank_cache_matches(cache: str, filename: str,
@@ -762,7 +767,7 @@ def _rank_cache_matches(cache: str, filename: str,
     if not os.path.isfile(side):
         return False
     try:
-        with np.load(side) as z:
+        with read_npz(side) as z:
             if "seed" not in z.files or "query_lottery" not in z.files:
                 return False
             if int(z["seed"]) != int(config.data_random_seed):
@@ -827,7 +832,10 @@ def load_dataset(filename: str, config: Config,
                 _partition_binary_shard(ds, config, rank, num_shards,
                                         cache)
             elif num_shards > 1 and os.path.isfile(cache + ".rows.npz"):
-                with np.load(cache + ".rows.npz") as rz:
+                # checksummed read: a corrupt sidecar raises
+                # IntegrityError into the fallback below instead of
+                # silently desyncing the cluster's row partition
+                with read_npz(cache + ".rows.npz") as rz:
                     ds.local_rows = rz["rows"]
                     n_global = int(rz["n_global"])
             # the reference format carries no label_idx or init scores:
@@ -1157,7 +1165,11 @@ def _save_binary(ds: Dataset, path: str, num_class: int = 1) -> None:
             np.ascontiguousarray(ds.bins[inner], dtype=val_t).tobytes(),
         ])
         parts += [u64(len(feat)), feat]
-    with open(path, "wb") as f:
+    # atomic + checksummed stream (resilience/atomic): the sha256
+    # footer is appended past the format's last section, so the
+    # reference-format reader (which consumes declared section sizes)
+    # still reads the file while verify_file can prove it intact
+    with atomic_writer(path, checksum=True) as f:
         for p in parts:       # stream: no second full-file copy in RAM
             f.write(p)
     log.info("Saved data to binary file %s" % path)
@@ -1189,6 +1201,14 @@ def _load_binary(path: str) -> Dataset:
     preallocated bins matrix: peak memory is the bins matrix + one
     feature's transient, not 3x the file (the cache fast path must not
     blow the budget the streaming loader guarantees)."""
+    # checksum gate first: a bit-flipped payload would parse "cleanly"
+    # into poisoned bins (the section reader can only catch truncation);
+    # the caller's fallback turns this into a warning + text ingestion.
+    # Files without a footer (written by the reference binary or an
+    # older version) load unverified, as before.
+    status = verify_file(path)
+    if status.startswith("corrupt"):
+        raise IntegrityError("binary cache %s: %s" % (path, status))
     mm_file = np.memmap(path, dtype=np.uint8, mode="r")
     r = _BinReader(mm_file)
     hsize = int(r.take(np.uint64)[0])

@@ -31,6 +31,9 @@ from ..objectives import Objective
 from ..ops.grow import grow_tree, grow_tree_bagged
 from ..ops.predict import predict_leaf_binned
 from ..ops.split import SplitParams
+from ..resilience.atomic import read_npz, text_writer, write_npz
+from ..resilience.snapshot import fingerprint_diff, resume_fingerprint
+from ..resilience.faults import faultpoint
 from ..utils import log
 from ..utils.mt19937 import Mt19937Random
 from .tree import Tree
@@ -2005,6 +2008,7 @@ class GBDT:
             # explicit device_get: ONE counted transfer for the whole
             # batch (analysis/guards.py device_get accounting — bench
             # reports it as the per-tree sync metric)
+            faultpoint("flush.device_get")
             ints_all, floats_all = jax.device_get(
                 (jnp.stack([m.ints for m in pend]),
                  jnp.stack([m.floats for m in pend])))
@@ -2635,7 +2639,12 @@ class GBDT:
         """Incremental-append save (gbdt.cpp:351-400): holds back the last
         early_stopping_round trees until finish."""
         if self.saved_upto < 0:
-            self._model_file = open(filename, "w")
+            # atomic incremental save (resilience/atomic): trees stream
+            # to a sibling tmp across segments; the finish commit
+            # fsync+renames it into place, so a crash at ANY iteration
+            # leaves the previous complete model file, never a
+            # truncated one
+            self._model_file = text_writer(filename)
             f = self._model_file
             f.write(self.name + "\n")
             f.write("num_class=%d\n" % self.num_class)
@@ -2666,6 +2675,15 @@ class GBDT:
             f.write("\n" + self.feature_importance() + "\n")
             f.close()
             self._model_file = None
+
+    def abort_model_save(self) -> None:
+        """Discard an in-progress incremental save (graceful
+        preemption): the sibling tmp is removed instead of orphaned,
+        and the previously committed model file stays untouched."""
+        if self._model_file is not None:
+            self._model_file.abort()
+            self._model_file = None
+        self.saved_upto = -1
 
     def feature_importance(self) -> str:
         """Split-count importances (gbdt.cpp:458-485).  The reference
@@ -2756,9 +2774,17 @@ class GBDT:
             arrays["valid_scores_%d" % i] = np.asarray(vs)
         for name, rng in self._rng_streams():
             arrays[name] = rng.get_state()
+        # config/dataset binding: load_checkpoint (and resume=auto's
+        # snapshot validation) reject a snapshot whose run this booster
+        # does not continue — shape-coincident state under changed
+        # hyper-parameters would otherwise resume silently wrong
+        arrays["resume_fp"] = np.array(resume_fingerprint(self))
         arrays.update(self._extra_checkpoint_arrays())
-        with open(path, "wb") as f:   # keep the exact path (savez would
-            np.savez(f, **arrays)     # append .npz to a bare name)
+        # atomic + sha256-footered write (resilience/atomic.write_npz
+        # keeps the exact path — a direct savez would append .npz to a
+        # bare name, and a crash mid-write would leave a truncated
+        # archive that poisons the next resume)
+        write_npz(path, arrays)
 
     def _extra_checkpoint_arrays(self) -> dict:
         """Subclass hook: extra state for save_checkpoint (DART's device
@@ -2770,8 +2796,20 @@ class GBDT:
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a save_checkpoint snapshot into a booster built with
-        the same config and datasets."""
-        z = np.load(path)
+        the same config and datasets.  Raises
+        resilience.atomic.IntegrityError on a corrupt/truncated
+        snapshot (footer-less archives from older versions load
+        unverified)."""
+        z = read_npz(path)
+        if "resume_fp" in z.files:
+            want, have = str(z["resume_fp"]), resume_fingerprint(self)
+            if want != have:
+                z.close()
+                log.fatal("checkpoint %s was written under a different "
+                          "config/dataset (%s) — loading it would "
+                          "silently continue the OLD run; delete the "
+                          "snapshot or restore the original config"
+                          % (path, fingerprint_diff(want, have)))
         self.iter = int(z["iter"])
         self._stopped = bool(z["stopped"])
         self._dev_stopped = (
@@ -2881,6 +2919,7 @@ class GBDT:
         self.num_used_model = min(int(z["num_used_model"]),
                                   len(self._models) // self.num_class)
         self._restore_extra_checkpoint(z)
+        z.close()       # read_npz is lazy now: drop the archive's fd
 
     def _restored_gstate(self, ordl):
         """Gradient-state override matching a restored row order: the

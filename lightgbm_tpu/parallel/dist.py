@@ -23,7 +23,21 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience.faults import faultpoint
+# NetworkError re-exported: transport callers catch it from here
+from ..resilience.net import NetworkError as NetworkError
+from ..resilience.net import call_with_deadline, connect_with_retry
 from ..utils import log
+
+#: per-collective deadline in seconds (0 = wait forever); configured by
+#: init_distributed from config.dist_timeout_s.  A dead peer then
+#: raises NetworkError out of the blocked collective instead of
+#: hanging the trainer indefinitely.
+_COLLECTIVE_TIMEOUT = [0.0]
+
+
+def set_network_timeout(seconds: float) -> None:
+    _COLLECTIVE_TIMEOUT[0] = max(0.0, float(seconds))
 
 
 def parse_machine_list(path: str) -> List[Tuple[str, int]]:
@@ -103,9 +117,19 @@ def init_distributed(config) -> Tuple[int, int]:
                               "gloo")
         except Exception:
             pass
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=config.num_machines,
-                               process_id=rank)
+    # connect with exponential backoff under an overall deadline (the
+    # reference's linkers_socket.cpp:24-45 retry loop, typed): the
+    # coordinator routinely comes up AFTER the workers in a preemptible
+    # pool, and a refused first connect must not kill the job
+    def _connect() -> None:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=config.num_machines,
+                                   process_id=rank)
+
+    connect_with_retry(
+        _connect, "jax.distributed.initialize(%s)" % coordinator,
+        deadline_s=float(config.dist_connect_deadline_s))
+    set_network_timeout(float(config.dist_timeout_s))
     log.info("Distributed runtime up: rank %d/%d (coordinator %s)"
              % (rank, config.num_machines, coordinator))
     return rank, config.num_machines
@@ -113,10 +137,28 @@ def init_distributed(config) -> Tuple[int, int]:
 
 def process_allgather(array: np.ndarray) -> np.ndarray:
     """Allgather a host array across processes -> stacked [num_processes,
-    ...] (replaces Network::Allgather for load-time metadata)."""
+    ...] (replaces Network::Allgather for load-time metadata).
+
+    Runs under the configured collective deadline: a dead peer raises a
+    typed NetworkError instead of blocking forever (degrade-don't-hang;
+    resilience/net.py).  The dist.send/dist.recv faultpoints bracket
+    the exchange for deterministic chaos schedules."""
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(array))
+    faultpoint("dist.send")
+    out = call_with_deadline(
+        lambda: np.asarray(multihost_utils.process_allgather(array)),
+        _COLLECTIVE_TIMEOUT[0], "process_allgather")
+    faultpoint("dist.recv")
+    return out
+
+
+def vote_any(flag: bool) -> bool:
+    """Cross-rank boolean OR (one int64 allgather): True when ANY rank
+    votes True.  The one primitive behind early-stop agreement and
+    preemption agreement — both must see the identical collective."""
+    votes = process_allgather(np.array([int(flag)], dtype=np.int64))
+    return bool(votes.sum() > 0)
 
 
 def process_concat(array: np.ndarray) -> np.ndarray:

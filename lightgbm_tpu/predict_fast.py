@@ -195,7 +195,8 @@ def try_fast_predict(cfg: Config) -> bool:
             break
     if first is None:
         log.fatal("Data file %s is empty" % cfg.data)
-    with open(cfg.output_result, "wb") as out_f:
+    from .resilience.atomic import atomic_writer
+    with atomic_writer(cfg.output_result) as out_f:
         out_f.write(first)
         for chunk in gen:
             got = native.predict_chunk(chunk, fmt, sep, model.label_idx,

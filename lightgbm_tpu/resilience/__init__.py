@@ -1,0 +1,40 @@
+"""Fault-tolerance subsystem: crash-safe durable writes, training
+snapshots with auto-resume, deterministic fault injection, and hardened
+network failure paths.
+
+The production posture (ROADMAP north star; the Clipper-style serving
+notes in serving/batcher.py) is degrade-don't-die: a SIGKILL mid-run
+must never poison a durable artifact, a dead peer must produce a typed
+error instead of a hang, and overload must shed load instead of
+queueing without bound.  This package carries the pieces every layer
+shares:
+
+  atomic.py    the atomic-write helper under ALL durable artifacts
+               (tmp file + fsync + os.replace, optional sha256
+               integrity footer).  graftcheck rule GC008 forbids bare
+               `open(.., "wb")` / `np.savez` writes outside functions
+               contracted @contract.durable_write — this module is
+               where those functions live.
+  snapshot.py  periodic training snapshots (config: snapshot_period /
+               snapshot_dir / resume) over GBDT.save_checkpoint's
+               bit-exact state, with corrupt-snapshot skipping and
+               multi-host rank-agreement sync on resume.
+  faults.py    deterministic, seeded fault injection: named faultpoints
+               at the real seams (checkpoint write, deferred flush,
+               dist connect/send/recv, serving dispatch, /reload
+               parse), driven by schedules from config/env so chaos
+               tests are reproducible bit-for-bit.
+  net.py       typed NetworkError, connect retry with exponential
+               backoff under an overall deadline, and a thread-based
+               call deadline so a dead peer cannot hang a collective
+               forever.
+
+Everything here is jax-free (stdlib + numpy): the serving fallback, the
+CLI fast paths and the jax-free lint lanes all import it.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+__all__ = ["atomic", "faults", "net", "snapshot"]

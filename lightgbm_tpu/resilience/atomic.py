@@ -1,0 +1,357 @@
+"""Crash-safe durable writes: tmp file + fsync + os.replace, with an
+optional sha256 integrity footer.
+
+Every durable artifact the project writes — training snapshots, `.bin`
+dataset caches and their `.rows.npz` sidecars, model text files,
+predict result files — goes through this module.  A SIGKILL at ANY
+byte of the write leaves either the previous complete file or no file;
+it can never leave a truncated file under the final name (the bare
+`open(path, "wb")` it replaces could, and a truncated cache/snapshot
+poisons every later run).  graftcheck rule GC008 enforces the routing:
+a bare `open(.., "wb")` / `np.savez` outside a function contracted
+@contract.durable_write is a finding.
+
+Integrity footer (binary artifacts only — text formats the reference
+parses must stay byte-identical): 40 trailing bytes appended to the
+payload,
+
+    payload .. | b"LGTPUSUM" (8) | sha256(payload) (32)
+
+Readers that know the format (`read_verified`, `read_npz`,
+`verify_file`) strip + verify it; the reference-format `.bin` reader
+ignores trailing bytes by construction (it reads declared section
+sizes), so footered caches stay loadable by format-only readers.  A
+file WITHOUT the footer is "legacy": accepted, but it gets no
+corruption protection beyond its own parser.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import contextlib
+import hashlib
+import io
+import os
+import time
+from typing import IO, Any, Iterator, Mapping, Optional, Tuple, Union
+
+import numpy as np
+
+from ..analysis.contracts import contract
+
+#: 8-byte magic opening the 40-byte integrity footer
+FOOTER_MAGIC = b"LGTPUSUM"
+FOOTER_LEN = len(FOOTER_MAGIC) + 32
+
+
+class IntegrityError(RuntimeError):
+    """A checksummed artifact failed verification (truncated write,
+    bit flip, partial copy): the file must not be trusted."""
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory so the os.replace rename itself is durable
+    (best effort: not every filesystem supports directory fds)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _tmp_path(path: str) -> str:
+    """Sibling tmp name (same directory: os.replace must not cross
+    filesystems).  pid-tagged so concurrent writers (multi-host ranks
+    on a shared filesystem) cannot truncate each other's tmp."""
+    return "%s.%d.lgtmp" % (path, os.getpid())
+
+
+#: a foreign `.lgtmp` must look abandoned for this long before the
+#: sweep may reap it (live writers refresh mtime with every chunk /
+#: segment append; a preempted run's tmp goes quiet immediately)
+STALE_TMP_S = 900.0
+
+
+def _pid_alive(pid: int) -> bool:
+    """Is `pid` a live process ON THIS HOST?  PermissionError means
+    alive-but-not-ours; only ESRCH proves absence."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+def reap_if_abandoned(path: str, writer_pid: int) -> bool:
+    """Remove a pid-tagged `.lgtmp` iff its writer is ABANDONED: the
+    one safety predicate behind every tmp sweep (here and the snapshot
+    directory's).  A different pid alone does NOT prove a dead writer
+    — multi-host ranks on a shared filesystem may write the same
+    target concurrently, and two runs may share a snapshot_dir — so a
+    tmp is reaped only when its writer is provably dead on this host
+    AND the file has been quiet past STALE_TMP_S (a cross-host writer,
+    whose pid cannot be probed here, keeps its tmp alive by writing to
+    it).  Returns True when the tmp was removed."""
+    try:
+        quiet = time.time() - os.path.getmtime(path) > STALE_TMP_S
+    except OSError:
+        return False
+    if not quiet or _pid_alive(writer_pid):
+        return False
+    try:
+        os.remove(path)
+    except OSError:
+        return False
+    return True
+
+
+def _sweep_stale_tmps(path: str) -> None:
+    """Remove abandoned `.lgtmp` siblings for this target.  A SIGKILL
+    mid-write — the subsystem's core scenario — orphans one pid-tagged
+    tmp per crash, and every resume runs under a fresh pid, so without
+    a sweep a preemptible pool leaks one tmp (dataset-sized for `.bin`
+    caches) per preemption.  Reaping rides reap_if_abandoned's
+    dead-AND-quiet guard: live concurrent writers keep their tmps."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    prefix = os.path.basename(path) + "."
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    pid = os.getpid()
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".lgtmp")):
+            continue
+        mid = name[len(prefix):-len(".lgtmp")]
+        if not mid.isdigit() or int(mid) == pid:
+            continue
+        reap_if_abandoned(os.path.join(d, name), int(mid))
+
+
+def _footer(digest: bytes) -> bytes:
+    return FOOTER_MAGIC + digest
+
+
+def split_footer(data: bytes) -> Tuple[bytes, Optional[bytes]]:
+    """(payload, sha256-from-footer or None when no footer present)."""
+    if len(data) >= FOOTER_LEN \
+            and data[-FOOTER_LEN:-32] == FOOTER_MAGIC:
+        return data[:-FOOTER_LEN], data[-32:]
+    return data, None
+
+
+class _HashingFile:
+    """File wrapper that feeds every written byte to a sha256 — so
+    streaming writers (the `.bin` cache) get a footer without a second
+    pass over the data."""
+
+    def __init__(self, f: IO[bytes]):
+        self._f = f
+        self._sha = hashlib.sha256()
+
+    def write(self, b: Union[bytes, memoryview]) -> int:
+        self._sha.update(b)
+        return self._f.write(b)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def read(self, *args: Any) -> bytes:
+        # present (but unusable) so numpy's zipfile_factory treats this
+        # as a file object instead of os.fspath()-coercing it; zipfile
+        # never reads in mode "w".  No seek/tell ON PURPOSE: zipfile
+        # then writes in stream mode (data descriptors, no seek-back),
+        # keeping the hash consistent with the bytes on disk.
+        raise io.UnsupportedOperation("write-only handle")
+
+    def digest(self) -> bytes:
+        return self._sha.digest()
+
+
+@contract.durable_write
+@contextlib.contextmanager
+def atomic_writer(path: str, checksum: bool = False
+                  ) -> Iterator[Union[IO[bytes], _HashingFile]]:
+    """Stream a durable binary artifact: yields a write()-able handle
+    over a sibling tmp file; on clean exit appends the sha256 footer
+    (when `checksum`), fsyncs and os.replace()s into place.  On ANY
+    exception the tmp is removed and the final path is untouched.
+    Without `checksum` the raw file is yielded — large footer-less
+    artifacts (streamed predict results) must not pay a discarded
+    sha256 pass."""
+    _sweep_stale_tmps(path)
+    tmp = _tmp_path(path)
+    f = open(tmp, "wb")
+    hf = _HashingFile(f) if checksum else None
+    try:
+        yield hf if hf is not None else f
+        if hf is not None:
+            f.write(_footer(hf.digest()))
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        _fsync_dir(path)
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
+def atomic_write_bytes(path: str, payload: Union[bytes, memoryview],
+                       checksum: bool = True) -> None:
+    """One-shot atomic write of `payload` (+ integrity footer)."""
+    with atomic_writer(path, checksum=checksum) as f:
+        f.write(payload)
+
+
+class AtomicTextFile:
+    """Incremental text writer with atomic commit — the model-file
+    save cadence (GBDT.save_model_to_file appends trees across
+    segments, finalizing once).  Writes stream to a sibling tmp;
+    close() fsyncs and renames into place, so a crash at any point
+    leaves the previous complete model file (or nothing), never a
+    truncated one.  abort() discards the tmp."""
+
+    def __init__(self, path: str):
+        self.path = path
+        _sweep_stale_tmps(path)
+        self._tmp = _tmp_path(path)
+        self._f: Optional[IO[str]] = open(self._tmp, "w")
+
+    def write(self, s: str) -> int:
+        assert self._f is not None, "write after close/abort"
+        return self._f.write(s)
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        """Commit: fsync + os.replace under the final name."""
+        if self._f is None:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+        os.replace(self._tmp, self.path)
+        _fsync_dir(self.path)
+
+    def abort(self) -> None:
+        if self._f is None:
+            return
+        self._f.close()
+        self._f = None
+        with contextlib.suppress(OSError):
+            os.remove(self._tmp)
+
+
+@contract.durable_write
+def text_writer(path: str) -> AtomicTextFile:
+    """Open an incremental atomic text writer (model files)."""
+    return AtomicTextFile(path)
+
+
+# ---------------------------------------------------------------------------
+# verified readers
+# ---------------------------------------------------------------------------
+
+def read_verified(path: str) -> bytes:
+    """Read a durable artifact, verify + strip its integrity footer.
+    Raises IntegrityError on checksum mismatch; a footer-less file is
+    returned as-is (legacy)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    payload, want = split_footer(data)
+    if want is not None:
+        got = hashlib.sha256(payload).digest()
+        if got != want:
+            raise IntegrityError(
+                "%s failed sha256 verification (truncated or corrupt "
+                "write: %d payload bytes)" % (path, len(payload)))
+    return payload
+
+
+def verify_file(path: str) -> str:
+    """'ok' (footer verified) | 'legacy' (no footer) | 'corrupt: <why>'
+    — never raises (validation probes must not).  Streams the hash in
+    1 MiB chunks: large `.bin` caches stay within the loader's memory
+    budget."""
+    try:
+        size = os.path.getsize(path)
+        if size == 0:
+            return "corrupt: zero-length file"
+        with open(path, "rb") as f:
+            if size < FOOTER_LEN:
+                return "legacy"
+            f.seek(size - FOOTER_LEN)
+            tail = f.read(FOOTER_LEN)
+            if tail[:len(FOOTER_MAGIC)] != FOOTER_MAGIC:
+                return "legacy"
+            want = tail[len(FOOTER_MAGIC):]
+            f.seek(0)
+            sha = hashlib.sha256()
+            remaining = size - FOOTER_LEN
+            while remaining > 0:
+                chunk = f.read(min(1 << 20, remaining))
+                if not chunk:
+                    return "corrupt: short read"
+                sha.update(chunk)
+                remaining -= len(chunk)
+    except OSError as ex:
+        return "corrupt: unreadable (%s)" % ex
+    if sha.digest() != want:
+        return "corrupt: sha256 mismatch (truncated or bit-flipped)"
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# npz convenience (snapshots, .rows.npz sidecars)
+# ---------------------------------------------------------------------------
+
+@contract.durable_write
+def write_npz(path: str, arrays: Mapping[str, Any],
+              checksum: bool = True) -> None:
+    """Atomic + checksummed np.savez, streamed: the archive goes
+    straight to the tmp file (hashed as it is written), never
+    materialized in RAM — snapshots carry the whole scores matrix, and
+    an archive-sized transient spike per snapshot_period is real
+    money.  Keeps the exact `path` (a direct np.savez would append
+    .npz to a bare name, and a crash mid-write would leave a truncated
+    archive under the final name)."""
+    with atomic_writer(path, checksum=checksum) as f:
+        np.savez(f, **arrays)
+
+
+def read_npz(path: str) -> Any:
+    """Lazy np.load over a verified file (IntegrityError on checksum
+    mismatch; footer-less legacy archives load directly).  The hash is
+    streamed in chunks and arrays decompress on access — the file
+    bytes are never held whole in RAM.  np.load reads the archive in
+    place: zipfile locates the central directory by signature, so the
+    trailing 40-byte footer is ignored.  Returns the NpzFile
+    (context-manager + mapping, like np.load)."""
+    os.stat(path)           # a missing file stays OSError, not corrupt
+    status = verify_file(path)
+    if status.startswith("corrupt"):
+        raise IntegrityError("%s failed verification (%s)"
+                             % (path, status))
+    return np.load(path)
+
+
+__all__ = ["IntegrityError", "FOOTER_MAGIC", "FOOTER_LEN",
+           "atomic_writer", "atomic_write_bytes", "AtomicTextFile",
+           "text_writer", "split_footer", "read_verified",
+           "verify_file", "write_npz", "read_npz",
+           "reap_if_abandoned"]

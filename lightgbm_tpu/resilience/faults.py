@@ -1,0 +1,243 @@
+"""Deterministic fault injection: named faultpoints at the real seams.
+
+Chaos tests must be REPRODUCIBLE: "SIGKILL the trainer at a random
+iteration" is only a regression test if the same seed kills at the
+same iteration every run.  This module gives every failure seam a
+name, counts hits, and fires configured actions on exact hit numbers
+or on a seeded mt19937 Bernoulli draw — no wall clock, no ambient RNG.
+
+Faultpoints (the registry is closed: a faultpoint() call with an
+unknown name is a programming error, so the chaos suite can prove it
+exercised every seam):
+
+    checkpoint.write    entering a snapshot write (before any bytes)
+    checkpoint.commit   a snapshot is durable (after os.replace)
+    flush.device_get    the deferred tree flush, before its device_get
+    dist.connect        each distributed-runtime connect attempt
+    dist.send           entering a cross-process collective
+    dist.recv           a cross-process collective completed
+    serve.dispatch      the serving forest's device dispatch
+    reload.parse        /reload, before parsing the new model
+
+Schedule spec (config key `faults=...` or env LGBM_TPU_FAULTS;
+';'-separated entries):
+
+    <name>@<N>=<action>     fire on the Nth hit of <name> (1-based)
+    <name>@<N>+=<action>    fire on every hit from the Nth on
+    <name>%<M>=<action>     seeded Bernoulli: fire when the next
+                            mt19937 draw < M/1000 (per hit)
+    seed=<S>                mt19937 seed for the %-rules (default 0)
+
+Actions: `kill` (SIGKILL self — the preemption simulator), `exit:<C>`
+(os._exit(C)), `raise` / `raise:<msg>` (raise FaultInjected).  Example:
+LGBM_TPU_FAULTS="checkpoint.commit@3=kill" SIGKILLs the training
+process the instant its third snapshot becomes durable.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import os
+import signal
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from ..utils.mt19937 import Mt19937Random
+
+#: every failure seam wired through faultpoint() — closed registry
+KNOWN_FAULTPOINTS: Tuple[str, ...] = (
+    "checkpoint.write", "checkpoint.commit", "flush.device_get",
+    "dist.connect", "dist.send", "dist.recv",
+    "serve.dispatch", "reload.parse",
+)
+
+ENV_VAR = "LGBM_TPU_FAULTS"
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired at a named faultpoint."""
+
+
+class _Rule:
+    def __init__(self, name: str, action: str, arg: str,
+                 at: Optional[int] = None, sticky: bool = False,
+                 permille: Optional[int] = None):
+        self.name = name
+        self.action = action     # kill | exit | raise
+        self.arg = arg
+        self.at = at             # exact hit number (1-based)
+        self.sticky = sticky     # fire on every hit >= at
+        self.permille = permille
+
+    def fires(self, hit: int, draw: Optional[int]) -> bool:
+        if self.permille is not None:
+            return draw is not None and draw < self.permille
+        assert self.at is not None
+        return hit >= self.at if self.sticky else hit == self.at
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rules: Dict[str, List[_Rule]] = {}
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._rng: Optional[Mt19937Random] = None
+        self._configured = False
+        self._env_checked = False
+
+
+_REG = _Registry()
+
+
+def _parse_action(text: str) -> Tuple[str, str]:
+    action, _, arg = text.partition(":")
+    action = action.strip().lower()
+    if action not in ("kill", "exit", "raise"):
+        raise ValueError("unknown fault action %r (expect kill|"
+                         "exit[:code]|raise[:message])" % text)
+    return action, arg.strip()
+
+
+def _parse_entry(entry: str) -> Tuple[Optional[int], _Rule]:
+    """One spec entry -> (seed or None, rule or None-for-seed)."""
+    lhs, sep, rhs = entry.partition("=")
+    if not sep:
+        raise ValueError("invalid fault entry %r (missing '=')" % entry)
+    lhs = lhs.strip()
+    if lhs == "seed":
+        return int(rhs.strip()), _Rule("", "raise", "")
+    sticky = False
+    if lhs.endswith("+"):
+        sticky = True
+        lhs = lhs[:-1]
+    action, arg = _parse_action(rhs.strip())
+    if "@" in lhs:
+        name, _, n = lhs.partition("@")
+        name = name.strip()
+        rule = _Rule(name, action, arg, at=int(n), sticky=sticky)
+    elif "%" in lhs:
+        name, _, m = lhs.partition("%")
+        name = name.strip()
+        rule = _Rule(name, action, arg, permille=int(m))
+    else:
+        raise ValueError("invalid fault entry %r (expect name@N=action "
+                         "or name%%M=action)" % entry)
+    if rule.name not in KNOWN_FAULTPOINTS:
+        raise ValueError("unknown faultpoint %r (known: %s)"
+                         % (rule.name, ", ".join(KNOWN_FAULTPOINTS)))
+    return None, rule
+
+
+def configure(spec: str) -> None:
+    """Install a fault schedule (replaces any previous one and resets
+    the hit counters).  Empty spec = clear."""
+    seed = 0
+    rules: Dict[str, List[_Rule]] = {}
+    n_rules = 0
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        got_seed, rule = _parse_entry(entry)
+        if got_seed is not None:
+            seed = got_seed
+            continue
+        rules.setdefault(rule.name, []).append(rule)
+        n_rules += 1
+    with _REG._lock:
+        _REG._rules = rules
+        _REG._hits = {}
+        _REG._fired = {}
+        _REG._rng = Mt19937Random(seed)
+        _REG._configured = True
+        _REG._env_checked = True
+    if n_rules:
+        log.info("Fault injection armed: %s" % spec)
+
+
+def reset() -> None:
+    """Clear the schedule and counters (tests)."""
+    with _REG._lock:
+        _REG._rules = {}
+        _REG._hits = {}
+        _REG._fired = {}
+        _REG._rng = None
+        _REG._configured = False
+        _REG._env_checked = True
+
+
+def hits(name: str) -> int:
+    """How many times the named faultpoint was reached."""
+    with _REG._lock:
+        return _REG._hits.get(name, 0)
+
+
+def fired(name: str) -> int:
+    """How many times a rule FIRED at the named faultpoint (kill/exit
+    firings are unobservable from the same process, by design)."""
+    with _REG._lock:
+        return _REG._fired.get(name, 0)
+
+
+def _ensure_env() -> None:
+    if _REG._env_checked:
+        return
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        configure(spec)
+    else:
+        with _REG._lock:
+            _REG._env_checked = True
+
+
+def faultpoint(name: str) -> None:
+    """Mark a failure seam.  A no-op (one dict lookup under a lock)
+    unless a schedule armed a rule for `name`."""
+    if name not in KNOWN_FAULTPOINTS:
+        # explicit raise, not assert: the closed-registry guarantee
+        # (chaos suites prove every seam exercised) must survive -O
+        raise ValueError("unregistered faultpoint %r — add it to "
+                         "KNOWN_FAULTPOINTS" % name)
+    _ensure_env()
+    with _REG._lock:
+        hit = _REG._hits.get(name, 0) + 1
+        _REG._hits[name] = hit
+        rules = _REG._rules.get(name)
+        if not rules:
+            return
+        to_fire: Optional[_Rule] = None
+        for rule in rules:
+            draw = None
+            if rule.permille is not None and _REG._rng is not None:
+                draw = int(_REG._rng.next_ints(
+                    np.array([1000], dtype=np.int64))[0])
+            if rule.fires(hit, draw):
+                to_fire = rule
+                break
+        if to_fire is None:
+            return
+        _REG._fired[name] = _REG._fired.get(name, 0) + 1
+    _fire(name, hit, to_fire)
+
+
+def _fire(name: str, hit: int, rule: _Rule) -> None:
+    if rule.action == "kill":
+        log.warning("faultpoint %s hit %d: SIGKILL (injected)"
+                    % (name, hit))
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif rule.action == "exit":
+        code = int(rule.arg) if rule.arg else 42
+        log.warning("faultpoint %s hit %d: os._exit(%d) (injected)"
+                    % (name, hit, code))
+        os._exit(code)
+    raise FaultInjected(rule.arg or "injected fault at %s (hit %d)"
+                        % (name, hit))
+
+
+__all__ = ["KNOWN_FAULTPOINTS", "ENV_VAR", "FaultInjected",
+           "configure", "reset", "hits", "fired", "faultpoint"]

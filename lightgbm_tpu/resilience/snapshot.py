@@ -1,0 +1,379 @@
+"""Periodic crash-safe training snapshots with auto-resume.
+
+A TPU pool is preemptible by design: a SIGKILL mid-run must cost at
+most `snapshot_period` iterations, not the job.  The manager rides
+GBDT.save_checkpoint — the existing bit-exact full-state snapshot
+(trees, scores, bag windows, DART banks, ordered-partition layouts,
+mt19937 stream positions) — and adds the operational layer:
+
+  * cadence: a snapshot at every `snapshot_period`-iteration boundary
+    the segment loop crosses, written atomically with a sha256 footer
+    (resilience/atomic), so a crash mid-write can never leave a
+    poisoned snapshot under a valid name;
+  * retention: the newest `snapshot_keep` snapshots per rank (0 = keep
+    everything);
+  * resume: `resume=auto` picks the latest snapshot that VALIDATES
+    (checksum + archive + required keys), skipping corrupt/truncated
+    ones with a warning naming the file and the reason; `resume=<path>`
+    requires that exact snapshot to validate; `resume=off` ignores
+    snapshots;
+  * multi-host agreement: every rank writes its own rank-tagged file;
+    on resume the ranks allgather their valid iteration sets and load
+    the newest COMMON iteration — or abort with a clear error when no
+    common iteration exists (ranks must never silently resume from
+    different iterations: the SPMD streams would diverge).
+
+Graceful preemption: cli.train converts SIGTERM into a final snapshot
+at the next segment boundary and a clean exit.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import os
+import re
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from . import atomic
+from .faults import faultpoint
+
+#: snapshot archives must carry these keys to count as valid
+REQUIRED_KEYS = ("iter", "num_trees", "scores")
+
+_NAME_RE = re.compile(r"^snapshot_r(\d+)_iter(\d+)\.lgts$")
+
+#: orphaned atomic-write tmps (resilience/atomic._tmp_path): a SIGKILL
+#: mid-snapshot — the subsystem's core scenario — leaves one behind
+_TMP_RE = re.compile(r"^snapshot_r(\d+)_iter\d+\.lgts\.(\d+)\.lgtmp$")
+
+#: how many of a rank's newest snapshots resume-agreement considers
+SYNC_WINDOW = 16
+
+#: config keys bound into every snapshot (resume_fingerprint): resuming
+#: under a config that disagrees on ANY of these would silently continue
+#: the OLD run under the NEW name — the snapshot is rejected instead.
+#: Deliberately excludes num_iterations / early_stopping_round
+#: (extending or re-capping a run and resuming it is the one legitimate
+#: config change), metric/printing keys (they shape output, not state),
+#: and all paths/ports (they legitimately differ per rank / per move).
+FP_KEYS = ("objective", "boosting_type", "tree_learner", "num_class",
+           "num_leaves", "max_depth", "max_bin", "min_data_in_leaf",
+           "min_sum_hessian_in_leaf", "learning_rate", "lambda_l1",
+           "lambda_l2", "min_gain_to_split", "feature_fraction",
+           "feature_fraction_seed", "bagging_fraction", "bagging_freq",
+           "bagging_seed", "data_random_seed", "drop_rate", "drop_seed",
+           "sigmoid", "top_k", "hist_dtype", "hist_impl", "hist_agg",
+           "num_shards", "num_machines")
+
+
+def resume_fingerprint(booster: Any) -> str:
+    """Config + dataset binding for a snapshot, as a readable k=v
+    string (not a digest: a rejected resume must say WHICH keys
+    moved).  Dataset identity rides shape — num_data/num_features
+    catch a swapped data file without binding to a path."""
+    cfg = getattr(booster, "config", None)
+    parts = ["%s=%r" % (k, getattr(cfg, k, None)) for k in FP_KEYS]
+    td = getattr(booster, "train_data", None)
+    parts.append("num_data=%r" % getattr(booster, "num_data", None))
+    parts.append("num_features=%r"
+                 % getattr(td, "num_total_features", None))
+    return ";".join(parts)
+
+
+def fingerprint_diff(snap_fp: str, run_fp: str) -> str:
+    """Human-readable key-by-key diff of two fingerprint strings."""
+    snap = dict(p.split("=", 1) for p in snap_fp.split(";") if "=" in p)
+    run = dict(p.split("=", 1) for p in run_fp.split(";") if "=" in p)
+    keys = sorted(k for k in set(snap) | set(run)
+                  if snap.get(k) != run.get(k))
+    return ", ".join("%s: snapshot %s vs run %s"
+                     % (k, snap.get(k, "<absent>"), run.get(k, "<absent>"))
+                     for k in keys)
+
+
+def snapshot_name(iteration: int, rank: int = 0) -> str:
+    return "snapshot_r%d_iter%08d.lgts" % (rank, iteration)
+
+
+def _probe_snapshot(path: str, expect_fp: Optional[str] = None
+                    ) -> Tuple[Optional[str], int]:
+    """(rejection reason or None, snapshot iteration) with ONE
+    verified read — the explicit-resume path needs the iteration too,
+    and snapshots carry the whole scores matrix, so a second
+    full-file hash just to read `iter` is real money."""
+    try:
+        if os.path.getsize(path) == 0:
+            return "corrupt: zero-length file", 0
+    except OSError as ex:
+        return "corrupt: unreadable (%s)" % ex, 0
+    try:
+        with atomic.read_npz(path) as z:
+            missing = [k for k in REQUIRED_KEYS if k not in z.files]
+            fp = (str(z["resume_fp"]) if "resume_fp" in z.files
+                  else None)
+            it = 0 if "iter" in missing else int(z["iter"])
+        if missing:
+            return "corrupt: missing key(s) %s" % ", ".join(missing), 0
+    except atomic.IntegrityError as ex:
+        return "corrupt: %s" % ex, 0
+    except Exception as ex:
+        # a truncated/garbled zip raises zipfile.BadZipFile or
+        # ValueError depending on where the damage landed
+        return "corrupt: unreadable archive (%s)" % ex, 0
+    if expect_fp is not None and fp is not None and fp != expect_fp:
+        # fp=None is a pre-fingerprint snapshot: accepted (legacy),
+        # load_checkpoint has no stronger information either
+        return ("stale: written by a different config/dataset (%s)"
+                % fingerprint_diff(fp, expect_fp)), it
+    return None, it
+
+
+def validate_snapshot(path: str,
+                      expect_fp: Optional[str] = None) -> Optional[str]:
+    """None when the snapshot is loadable, else a human-readable
+    rejection reason (zero-length, checksum mismatch, unreadable
+    archive, missing keys, config/dataset fingerprint mismatch when
+    `expect_fp` is given).  ONE streamed hash per candidate (read_npz
+    verifies in place and loads arrays lazily): resume=auto probes up
+    to SYNC_WINDOW of them."""
+    return _probe_snapshot(path, expect_fp)[0]
+
+
+class SnapshotManager:
+    """Cadenced snapshot writes + resume for one training process."""
+
+    def __init__(self, directory: str, period: int, resume: str,
+                 keep: int = 4, rank: int = 0,
+                 num_machines: int = 1, max_iteration: int = 0):
+        self.directory = directory
+        self.period = int(period)
+        self.resume = resume
+        self.keep = int(keep)
+        self.rank = int(rank)
+        self.num_machines = int(num_machines)
+        # resume must never hand back MORE iterations than this run
+        # asked for (0 = uncapped): a snapshot past the cap would skip
+        # the training loop and silently save an oversized model
+        self.max_iteration = int(max_iteration)
+        self._last = 0          # iteration of the newest snapshot/resume
+
+    @staticmethod
+    def from_config(cfg: Any, rank: int = 0, num_machines: int = 1,
+                    max_iteration: Optional[int] = None
+                    ) -> Optional["SnapshotManager"]:
+        period = int(cfg.snapshot_period)
+        resume = (cfg.resume or "off").strip()
+        if period <= 0 and resume == "off":
+            return None
+        if period > 0 and not cfg.snapshot_dir:
+            log.fatal("snapshot_period=%d requires snapshot_dir" % period)
+        if resume == "auto" and not cfg.snapshot_dir:
+            log.fatal("resume=auto requires snapshot_dir")
+        if max_iteration is None:
+            max_iteration = int(cfg.num_iterations)
+        return SnapshotManager(cfg.snapshot_dir, period, resume,
+                               keep=int(cfg.snapshot_keep), rank=rank,
+                               num_machines=num_machines,
+                               max_iteration=max_iteration)
+
+    # -- write cadence --------------------------------------------------
+    def due(self, iteration: int) -> bool:
+        """True when the segment loop crossed a period boundary since
+        the last snapshot (segments may advance several iterations at
+        once)."""
+        if self.period <= 0:
+            return False
+        return iteration // self.period > self._last // self.period
+
+    def write(self, booster: Any) -> str:
+        """Snapshot the booster's full state (atomic + checksummed).
+        The `checkpoint.write` faultpoint fires before any bytes exist,
+        `checkpoint.commit` the instant the snapshot is durable."""
+        iteration = int(booster.iter)
+        faultpoint("checkpoint.write")
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory,
+                            snapshot_name(iteration, self.rank))
+        booster.save_checkpoint(path)
+        faultpoint("checkpoint.commit")
+        self._last = iteration
+        self._prune()
+        log.info("Snapshot written: %s (iteration %d)"
+                 % (path, iteration))
+        return path
+
+    def _prune(self) -> None:
+        self._sweep_orphan_tmps()
+        if self.keep <= 0:
+            return
+        for iteration, path in self._candidates()[self.keep:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _sweep_orphan_tmps(self) -> None:
+        """Remove THIS rank's `.lgtmp` leftovers from dead writers (a
+        SIGKILL mid-snapshot orphans one per crash; retention never
+        matches them, so a preemptible pool would otherwise grow them
+        without bound).  Reaping rides atomic.reap_if_abandoned's
+        dead-AND-quiet guard — a second live run sharing snapshot_dir
+        keeps its mid-write tmp — and other RANKS' tmps on a shared
+        filesystem are not ours to touch."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        pid = os.getpid()
+        for name in names:
+            m = _TMP_RE.match(name)
+            if m is None or int(m.group(1)) != self.rank \
+                    or int(m.group(2)) == pid:
+                continue
+            atomic.reap_if_abandoned(os.path.join(self.directory, name),
+                                     int(m.group(2)))
+
+    # -- discovery ------------------------------------------------------
+    def _candidates(self) -> List[Tuple[int, str]]:
+        """This rank's snapshots, newest first."""
+        out: List[Tuple[int, str]] = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            m = _NAME_RE.match(name)
+            if m is not None and int(m.group(1)) == self.rank:
+                out.append((int(m.group(2)),
+                            os.path.join(self.directory, name)))
+        out.sort(reverse=True)
+        return out
+
+    def valid_iters(self, limit: int = SYNC_WINDOW,
+                    expect_fp: Optional[str] = None) -> List[int]:
+        """Iterations with a VALID snapshot for this rank, newest
+        first; corrupt/stale files are skipped with a warning naming
+        the file and the reason."""
+        out: List[int] = []
+        for iteration, path in self._candidates():
+            if len(out) >= limit:
+                break
+            if self.max_iteration > 0 and iteration > self.max_iteration:
+                # a longer earlier run left snapshots past this run's
+                # cap: resuming one would skip the training loop and
+                # save a model with MORE iterations than requested
+                log.warning("Skipping snapshot %s: iteration %d is "
+                            "beyond this run's num_iterations=%d"
+                            % (path, iteration, self.max_iteration))
+                continue
+            reason = validate_snapshot(path, expect_fp=expect_fp)
+            if reason is None:
+                out.append(iteration)
+            else:
+                log.warning("Skipping snapshot %s: %s" % (path, reason))
+        return out
+
+    # -- resume ---------------------------------------------------------
+    def maybe_resume(self, booster: Any) -> int:
+        """Restore the booster per the `resume` policy; returns the
+        resumed iteration (0 = fresh start).  Multi-host: all ranks
+        agree on ONE common iteration or training aborts."""
+        if self.resume == "off":
+            return 0
+        expect_fp = resume_fingerprint(booster)
+        if self.resume == "auto":
+            iters = self.valid_iters(expect_fp=expect_fp)
+        else:
+            # explicit path: that exact snapshot must validate — and in
+            # multi-host mode it must belong to THIS rank (a shared conf
+            # naming rank 0's file would pass _agree's iteration check
+            # while loading another rank's shard scores/bag windows/RNG
+            # streams: exactly the silent SPMD divergence to abort on)
+            m = _NAME_RE.match(os.path.basename(self.resume))
+            if self.num_machines > 1 and m is not None \
+                    and int(m.group(1)) != self.rank:
+                log.fatal("resume=%s names rank %s's snapshot, but this "
+                          "is rank %d: every rank must restore ITS OWN "
+                          "shard state (use resume=auto or a per-rank "
+                          "path)" % (self.resume, m.group(1), self.rank))
+            reason, it = _probe_snapshot(self.resume,
+                                         expect_fp=expect_fp)
+            if reason is not None:
+                log.fatal("resume=%s: snapshot rejected: %s"
+                          % (self.resume, reason))
+            if self.max_iteration > 0 and it > self.max_iteration:
+                log.fatal("resume=%s: snapshot iteration %d is beyond "
+                          "this run's num_iterations=%d — the model "
+                          "would silently contain more iterations than "
+                          "requested" % (self.resume, it,
+                                         self.max_iteration))
+            self._agree(it)
+            booster.load_checkpoint(self.resume)
+            self._last = it
+            log.info("Resumed from snapshot %s (iteration %d)"
+                     % (self.resume, it))
+            return it
+        target = self._agree_latest(iters)
+        if target <= 0:
+            log.info("resume=auto: no valid snapshot in %s — starting "
+                     "fresh" % self.directory)
+            return 0
+        path = os.path.join(self.directory,
+                            snapshot_name(target, self.rank))
+        booster.load_checkpoint(path)
+        self._last = target
+        log.info("Resumed from snapshot %s (iteration %d)"
+                 % (path, target))
+        return target
+
+    def _agree(self, iteration: int) -> None:
+        """Multi-host: every rank must resume the SAME iteration."""
+        if self.num_machines <= 1:
+            return
+        from ..parallel.dist import process_allgather
+        alls = process_allgather(
+            np.array([iteration], dtype=np.int64)).reshape(-1)
+        if not (alls == alls[0]).all():
+            log.fatal("Ranks disagree on the resume iteration (%s): "
+                      "every rank must restore the same snapshot "
+                      "iteration or the SPMD streams diverge"
+                      % alls.tolist())
+
+    def _agree_latest(self, iters: List[int]) -> int:
+        """resume=auto agreement: the newest iteration EVERY rank holds
+        a valid snapshot for.  -1 entries pad the gathered window."""
+        if self.num_machines <= 1:
+            return iters[0] if iters else 0
+        from ..parallel.dist import process_allgather
+        pad = np.full(SYNC_WINDOW, -1, dtype=np.int64)
+        pad[:min(len(iters), SYNC_WINDOW)] = iters[:SYNC_WINDOW]
+        alls = process_allgather(pad)            # [P, SYNC_WINDOW]
+        sets = [set(int(v) for v in row if v >= 0) for row in alls]
+        common = set.intersection(*sets) if sets else set()
+        if common:
+            return max(common)
+        if not any(sets):
+            return 0          # no rank has anything: fresh start
+        log.fatal("resume=auto: no snapshot iteration is valid on "
+                  "EVERY rank (per-rank valid iterations: %s) — "
+                  "restore the missing/corrupt snapshot files or "
+                  "restart with resume=off"
+                  % [sorted(s) for s in sets])
+
+    def sync_flag(self, flag: bool) -> bool:
+        """OR a per-rank boolean across ranks (preemption agreement:
+        one rank's SIGTERM must stop every rank at the same segment
+        boundary)."""
+        if self.num_machines <= 1:
+            return flag
+        from ..parallel.dist import vote_any
+        return vote_any(flag)
+
+
+__all__ = ["SnapshotManager", "snapshot_name", "validate_snapshot",
+           "resume_fingerprint", "fingerprint_diff", "REQUIRED_KEYS",
+           "FP_KEYS"]

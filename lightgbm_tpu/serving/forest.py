@@ -37,6 +37,7 @@ import numpy as np
 
 from ..analysis.contracts import contract
 from ..models.tree import Tree, parse_model_text
+from ..resilience.faults import faultpoint
 from ..utils import log
 
 MODES = ("normal", "raw", "leaf")
@@ -82,6 +83,7 @@ class ServingForest:
         self.loaded_at = time.time()
 
         self._engine = self._pick_engine(backend)
+        self._degraded = False          # circuit breaker pinned us to host
         self._lock = threading.Lock()   # guards lazy pack builds only
         self._jax_pack: Optional[Dict[str, Any]] = None
         self._native_spec: Optional[Any] = None
@@ -107,6 +109,23 @@ class ServingForest:
     @property
     def engine(self) -> str:
         return self._engine
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def degrade(self) -> None:
+        """Circuit breaker: pin this forest to the JAX-free host
+        engine after repeated device-dispatch failures.  One-way until
+        /reload builds a fresh forest; the host packs warm immediately
+        so the next request needs no lazy build."""
+        with self._lock:
+            if self._engine != "jax":
+                return
+            self._engine = "host"
+            self._degraded = True
+        self._build_host_pack()
+        self._native_forest()
 
     # -- packed representations ----------------------------------------
     def _flat_arrays(self) -> Tuple[np.ndarray, np.ndarray,
@@ -190,12 +209,18 @@ class ServingForest:
             x = x[:, :want]
         return x
 
-    def _leaves(self, x: np.ndarray) -> np.ndarray:
+    def _leaves(self, x: np.ndarray,
+                engine: Optional[str] = None) -> np.ndarray:
         """[N, F] f64 -> [N, T] leaf indices, one dispatch (JAX engine)
         or the vectorized numpy descent (host engine) — identical f64
-        `value <= threshold` routing either way."""
+        `value <= threshold` routing either way.  `engine` overrides
+        the forest's engine for THIS call (the circuit breaker answers
+        a failed device dispatch on the host path)."""
         n = x.shape[0]
-        if self._engine == "jax":
+        if (engine or self._engine) == "jax":
+            # the device dispatch is a real failure seam (remote TPU
+            # tunnel, OOM, backend death): chaos schedules fail it here
+            faultpoint("serve.dispatch")
             import jax.numpy as jnp
             from ..ops.predict import predict_leaf_stacked, split_hi_lo
             pack = self._build_jax_pack()
@@ -211,12 +236,16 @@ class ServingForest:
             out[:, i] = tr.predict_leaf_index(x)
         return out
 
-    def predict(self, x: np.ndarray, mode: str) -> np.ndarray:
+    def predict(self, x: np.ndarray, mode: str,
+                engine: Optional[str] = None) -> np.ndarray:
         """Batch predict on parsed rows.  mode 'leaf' -> [N, T] int;
         'raw'/'normal' -> [K, N] f64 (normal applies sigmoid/softmax,
-        the exact GBDT.predict expressions)."""
+        the exact GBDT.predict expressions).  `engine` forces one
+        engine for this call (circuit-breaker fallback); bytes are
+        identical either way (tests pin host-vs-jax parity)."""
         if mode not in MODES:
             raise ValueError("unknown predict mode %r" % mode)
+        eng = engine or self._engine
         x = self.fit_width(x)
         n = x.shape[0]
         k = self.num_class
@@ -224,12 +253,12 @@ class ServingForest:
         if mode == "leaf":
             if n == 0 or t == 0:
                 return np.zeros((n, t), dtype=np.int64)
-            return self._leaves(x)
+            return self._leaves(x, eng)
         if n == 0 or t == 0:
             raw = np.zeros((k, n), dtype=np.float64)
         else:
-            leaves = self._leaves(x)
-            lv = (self._build_jax_pack() if self._engine == "jax"
+            leaves = self._leaves(x, eng)
+            lv = (self._build_jax_pack() if eng == "jax"
                   else self._build_host_pack())["lv"]
             raw = np.zeros((k, n), dtype=np.float64)
             # per-tree f64 accumulation in boosting order, exactly the
@@ -295,6 +324,7 @@ class ServingForest:
         return {
             "source": self.source,
             "engine": self._engine,
+            "degraded": self._degraded,
             "num_models": self.num_models,
             "num_class": self.num_class,
             "max_feature_idx": self.max_feature_idx,

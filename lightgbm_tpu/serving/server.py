@@ -42,6 +42,7 @@ import numpy as np
 from ..analysis.contracts import contract
 from ..config import Config
 from ..io.parser import parse_predict_rows, sniff_format
+from ..resilience.faults import faultpoint
 from ..utils import log
 from .batcher import BatcherClosed, MicroBatcher, RowsPayload, TextPayload
 from .forest import MODES, ServingForest, load_forest
@@ -100,6 +101,9 @@ class Metrics:
         self.rows_total = 0
         self.batches_total = 0
         self.reloads_total = 0
+        self.reload_failures_total = 0
+        self.dispatch_failures_total = 0
+        self.overload_rejected_total = 0
         self.in_flight = 0
         self.latency = _Histogram(_LATENCY_BUCKETS)
         self.batch_rows = _Histogram(_BATCH_ROW_BUCKETS)
@@ -131,7 +135,20 @@ class Metrics:
         with self._lock:
             self.reloads_total += 1
 
-    def render(self, forest: ServingForest) -> bytes:
+    def reload_failed(self) -> None:
+        with self._lock:
+            self.reload_failures_total += 1
+
+    def dispatch_failed(self) -> None:
+        with self._lock:
+            self.dispatch_failures_total += 1
+
+    def overload_rejected(self) -> None:
+        with self._lock:
+            self.overload_rejected_total += 1
+
+    def render(self, forest: ServingForest, degraded: bool = False,
+               inflight_rows: int = 0) -> bytes:
         out: List[str] = []
         with self._lock:
             out.append("# HELP lgbm_serve_requests_total "
@@ -152,10 +169,36 @@ class Metrics:
                        "successful hot model swaps")
             out.append("# TYPE lgbm_serve_reloads_total counter")
             out.append("lgbm_serve_reloads_total %d" % self.reloads_total)
+            out.append("# HELP lgbm_serve_reload_failures_total "
+                       "failed /reload attempts (old model kept serving)")
+            out.append("# TYPE lgbm_serve_reload_failures_total counter")
+            out.append("lgbm_serve_reload_failures_total %d"
+                       % self.reload_failures_total)
+            out.append("# HELP lgbm_serve_dispatch_failures_total "
+                       "device-dispatch failures answered on the "
+                       "native fallback")
+            out.append("# TYPE lgbm_serve_dispatch_failures_total counter")
+            out.append("lgbm_serve_dispatch_failures_total %d"
+                       % self.dispatch_failures_total)
+            out.append("# HELP lgbm_serve_overload_rejected_total "
+                       "predict requests shed with 503 + Retry-After "
+                       "by admission control")
+            out.append("# TYPE lgbm_serve_overload_rejected_total counter")
+            out.append("lgbm_serve_overload_rejected_total %d"
+                       % self.overload_rejected_total)
+            out.append("# HELP lgbm_serve_degraded "
+                       "1 when the circuit breaker pinned serving to "
+                       "the JAX-free native predictor")
+            out.append("# TYPE lgbm_serve_degraded gauge")
+            out.append("lgbm_serve_degraded %d" % int(degraded))
             out.append("# HELP lgbm_serve_in_flight "
                        "requests currently being handled")
             out.append("# TYPE lgbm_serve_in_flight gauge")
             out.append("lgbm_serve_in_flight %d" % self.in_flight)
+            out.append("# HELP lgbm_serve_inflight_rows "
+                       "admitted prediction rows currently in flight")
+            out.append("# TYPE lgbm_serve_inflight_rows gauge")
+            out.append("lgbm_serve_inflight_rows %d" % inflight_rows)
             out.append("# HELP lgbm_serve_model_loaded_timestamp_seconds "
                        "unix time the live model was loaded")
             out.append("# TYPE lgbm_serve_model_loaded_timestamp_seconds "
@@ -186,6 +229,14 @@ class BadRequest(ValueError):
 
 class LengthRequired(BadRequest):
     status = 411
+
+
+def _error_json(ex: BaseException) -> bytes:
+    """Structured error body: {"error": <class>, "message": <str>} —
+    machine-parseable by clients and load balancers instead of a bare
+    status line."""
+    return (json.dumps({"error": type(ex).__name__,
+                        "message": str(ex)}) + "\n").encode()
 
 
 def _strip_first_line(text: bytes) -> bytes:
@@ -242,6 +293,21 @@ def _sniff_sep(body: bytes) -> Tuple[str, str]:
     return sniff_format(lambda: next(chunks, b""))
 
 
+def _estimate_rows(body: bytes, is_json: bool) -> int:
+    """Cheap row estimate for admission control BEFORE any parse work:
+    shedding must not burn parse CPU/memory on requests it is about to
+    503.  Text bodies are one row per line, counted under the SAME
+    universal line endings splitlines() honors — a bare-'\\r' body
+    must not estimate ~0 rows and slip a huge parse past admission.
+    JSON rows are one '['-opened list each, plus one for the enclosing
+    list.  The admitted count is trued up to the parsed row count
+    afterwards, so the estimate only has to be close."""
+    if is_json:
+        return max(0, body.count(b"[") - 1)
+    return (body.count(b"\n") + body.count(b"\r")
+            - body.count(b"\r\n"))
+
+
 # ---------------------------------------------------------------------------
 # Serving state: forest + batcher + metrics, hot-swappable
 # ---------------------------------------------------------------------------
@@ -253,6 +319,19 @@ class ServingState:
         self._forest = forest
         self._swap_lock = threading.Lock()   # serializes /reload only
         self.draining = False
+        # admission control (degrade-don't-die): bounded in-flight ROWS
+        # — past the bound new requests get a fast 503 + Retry-After
+        # instead of queueing without bound in the batcher
+        self.max_inflight_rows = cfg.serve_max_inflight_rows
+        self.retry_after_s = cfg.serve_retry_after_s
+        self._adm_lock = threading.Lock()
+        self._inflight_rows = 0
+        # circuit breaker: consecutive device-dispatch failures before
+        # the forest pins itself to the JAX-free native predictor
+        self.breaker_threshold = cfg.serve_breaker_threshold
+        self._breaker_lock = threading.Lock()
+        self._dispatch_failures = 0
+        self.degraded = False
         self.batcher = MicroBatcher(
             self._run_batch, cfg.serve_max_batch_rows,
             cfg.serve_batch_timeout_ms,
@@ -261,6 +340,75 @@ class ServingState:
     @property
     def forest(self) -> ServingForest:
         return self._forest
+
+    @property
+    def inflight_rows(self) -> int:
+        with self._adm_lock:
+            return self._inflight_rows
+
+    # -- admission control ---------------------------------------------
+    def try_admit(self, nrows: int) -> bool:
+        """Admit `nrows` against the in-flight budget.  An idle server
+        always admits (a single oversized request still gets served —
+        the batcher splits it); under load, anything that would push
+        past the bound is shed."""
+        with self._adm_lock:
+            if self._inflight_rows > 0 \
+                    and self._inflight_rows + nrows \
+                    > self.max_inflight_rows:
+                return False
+            self._inflight_rows += nrows
+            return True
+
+    def release(self, nrows: int) -> None:
+        with self._adm_lock:
+            self._inflight_rows -= nrows
+
+    # -- circuit breaker ------------------------------------------------
+    def _guarded_predict(self, forest: ServingForest, batch: Any,
+                         mode: str) -> Any:
+        """Device predict with degrade-don't-die semantics: a failed
+        device dispatch answers THIS batch on the JAX-free host path
+        (byte-identical — tests pin engine parity), and after
+        `breaker_threshold` consecutive failures the breaker pins the
+        forest to the host engine until /reload."""
+        if forest.engine != "jax":
+            return forest.predict(batch, mode)
+        try:
+            res = forest.predict(batch, mode)
+        except log.LightGBMError:
+            raise              # data error: the client's fault, not the device's
+        except Exception as ex:
+            self._dispatch_failure(forest, ex)
+            return forest.predict(batch, mode, engine="host")
+        with self._breaker_lock:
+            if forest is self._forest:
+                self._dispatch_failures = 0
+        return res
+
+    def _dispatch_failure(self, forest: ServingForest,
+                          ex: BaseException) -> None:
+        self.metrics.dispatch_failed()
+        with self._breaker_lock:
+            # in-flight batches stay pinned to a pre-/reload forest by
+            # design: its failures must not count against (or trip) the
+            # breaker on the fresh live forest
+            if forest is not self._forest:
+                n, trip = 0, False
+            else:
+                self._dispatch_failures += 1
+                n = self._dispatch_failures
+                trip = n >= self.breaker_threshold and not self.degraded
+                if trip:
+                    self.degraded = True
+        log.warning("serve: device dispatch failed (%s: %s); answered "
+                    "on the native fallback" % (type(ex).__name__, ex))
+        if trip:
+            forest.degrade()
+            log.warning("serve: circuit breaker OPEN after %d "
+                        "consecutive device-dispatch failures — "
+                        "serving on the JAX-free native predictor "
+                        "until /reload" % n)
 
     # -- the coalesced dispatch (MicroBatcher worker thread) -----------
     # Batches key on (forest, mode, family): the forest object isolates
@@ -307,13 +455,19 @@ class ServingState:
         counts = [f.shape[0] for f in feats]
         batch = (np.concatenate(feats, axis=0) if len(feats) > 1
                  else feats[0])
-        res = forest.predict(batch, mode)
+        res = self._guarded_predict(forest, batch, mode)
         blob = forest.format_rows(res, mode)
         return _split_lines(blob, counts)
 
     # -- hot swap -------------------------------------------------------
     def reload(self, model_path: str) -> Dict[str, Any]:
+        """Parse + warm the new model OFF TO THE SIDE, then swap the
+        reference atomically: ANY failure in here (unreadable path,
+        parse error, warm-up crash — the reload.parse faultpoint
+        simulates them) propagates BEFORE the swap, so the old forest
+        keeps serving untouched."""
         with self._swap_lock:
+            faultpoint("reload.parse")
             fresh = load_forest(model_path,
                                 num_model_predict=self.cfg.num_model_predict,
                                 backend=self.cfg.serve_backend)
@@ -321,6 +475,14 @@ class ServingState:
             old = self._forest
             self._forest = fresh   # atomic reference swap; in-flight
             #                        batches keep keying on `old`
+            with self._breaker_lock:
+                # a fresh forest gets a fresh device engine: close the
+                # breaker so degraded mode ends at the swap
+                self._dispatch_failures = 0
+                was_degraded = self.degraded
+                self.degraded = False
+            if was_degraded:
+                log.info("serve: circuit breaker closed by /reload")
             self.metrics.reloaded()
             log.info("Hot-swapped model %s (%d trees) -> %s (%d trees)"
                      % (old.source, old.num_models, fresh.source,
@@ -367,10 +529,13 @@ def _make_handler(state: ServingState) -> type:
             log.debug("serve: " + fmt % args)
 
         def _respond(self, code: int, body: bytes,
-                     ctype: str = "text/plain; charset=utf-8") -> None:
+                     ctype: str = "text/plain; charset=utf-8",
+                     headers: Optional[Dict[str, str]] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -412,8 +577,15 @@ def _make_handler(state: ServingState) -> type:
             code = 200
             try:
                 if path == "/healthz":
-                    doc = {"status": "draining" if state.draining
-                           else "ok",
+                    # degraded is a LIVE state worth alerting on, but
+                    # the server still answers correctly (native
+                    # fallback) — hence 200, with the status string
+                    # carrying the distinction
+                    status = ("draining" if state.draining
+                              else "degraded" if state.degraded
+                              else "ok")
+                    doc = {"status": status,
+                           "degraded": state.degraded,
                            "uptime_s": round(
                                time.time() - state.metrics.started_at, 3),
                            "model": state.forest.info()}
@@ -421,7 +593,9 @@ def _make_handler(state: ServingState) -> type:
                                   "application/json")
                 elif path == "/metrics":
                     self._respond(
-                        200, state.metrics.render(state.forest),
+                        200, state.metrics.render(
+                            state.forest, degraded=state.degraded,
+                            inflight_rows=state.inflight_rows),
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     code = 404
@@ -447,13 +621,15 @@ def _make_handler(state: ServingState) -> type:
                     self._respond(404, b"not found\n")
             except (BadRequest, log.LightGBMError) as ex:
                 # LightGBMError here is a data error (e.g. an unknown
-                # token while parsing the request body): client fault
+                # token while parsing the request body): client fault.
+                # Structured body: error class + message, not a bare
+                # status line.
                 code = getattr(ex, "status", 400)
-                self._respond(code, (str(ex) + "\n").encode())
+                self._respond(code, _error_json(ex), "application/json")
             except Exception as ex:
                 code = 500
                 log.warning("serve: internal error: %s" % ex)
-                self._respond(500, (str(ex) + "\n").encode())
+                self._respond(500, _error_json(ex), "application/json")
             finally:
                 state.metrics.request_finished(path, code,
                                                time.monotonic() - t0,
@@ -463,8 +639,12 @@ def _make_handler(state: ServingState) -> type:
             # read the body FIRST even on early-exit paths: an unread
             # body desyncs the next request on a keep-alive connection
             body = self._body()
+            retry_hdr = {"Retry-After":
+                         "%d" % max(1, round(state.retry_after_s))}
             if state.draining:
-                self._respond(503, b"draining\n")
+                self._respond(503, _error_json(
+                    RuntimeError("draining")), "application/json",
+                    headers=retry_hdr)
                 return 503, 0
             q = parse_qs(url.query)
             mode = q.get("mode", ["normal"])[0].lower()
@@ -473,32 +653,57 @@ def _make_handler(state: ServingState) -> type:
                                  "leaf)" % mode)
             ctype = (self.headers.get("Content-Type") or "").lower()
             forest = state.forest   # pin ONE forest for this request
-            if "json" in ctype:
-                payload = RowsPayload(_parse_json_rows(body))
-                family = ("rows",)
-            else:
+            is_json = "json" in ctype
+            if not is_json:
                 has_header = _qbool(q, "header", state.cfg.has_header)
                 if has_header:
                     body = _strip_first_line(body)
                 if body and not body.endswith(b"\n"):
                     body += b"\n"
-                if forest.engine == "jax":
+            # admission control BEFORE parsing: shed load FAST (503 +
+            # Retry-After) instead of queueing without bound — and
+            # without paying parse CPU/memory for requests about to be
+            # rejected.  Admission rides a cheap row estimate, trued up
+            # to the parsed count below.
+            admitted = _estimate_rows(body, is_json)
+            if not state.try_admit(admitted):
+                state.metrics.overload_rejected()
+                self._respond(503, _error_json(RuntimeError(
+                    "overloaded: %d rows in flight (budget %d); "
+                    "retry later" % (state.inflight_rows,
+                                     state.max_inflight_rows))),
+                    "application/json", headers=retry_hdr)
+                return 503, 0
+            try:
+                if is_json:
+                    payload = RowsPayload(_parse_json_rows(body))
+                    family = ("rows",)
+                elif forest.engine == "jax":
                     payload = RowsPayload(_parse_text_rows(body, forest))
                     family = ("rows",)
                 else:
                     fmt, sep = _sniff_sep(body)
                     payload = TextPayload(body, fmt, sep)
                     family = ("text", fmt, sep)
-            nrows = payload.nrows
-            try:
+                nrows = payload.nrows
+                if nrows != admitted:
+                    # true up to the real row count (an already-admitted
+                    # request keeps its slot even if the estimate ran
+                    # low — like the idle-server oversized case)
+                    state.release(admitted - nrows)
+                    admitted = nrows
                 parts = state.batcher.submit((forest, mode, family),
                                              payload)
             except BatcherClosed:
                 # raced the drain past the flag check above
-                self._respond(503, b"draining\n")
+                self._respond(503, _error_json(
+                    RuntimeError("draining")), "application/json",
+                    headers=retry_hdr)
                 return 503, 0
             except log.LightGBMError as ex:
                 raise BadRequest(str(ex))
+            finally:
+                state.release(admitted)
             self._respond(200, b"".join(parts))
             return 200, nrows
 
@@ -517,8 +722,19 @@ def _make_handler(state: ServingState) -> type:
                                  'or POST {"model": "<path>"}')
             try:
                 info = state.reload(path)
-            except (OSError, log.LightGBMError) as ex:
-                raise BadRequest("reload failed: %s" % ex)
+            except Exception as ex:
+                # ANY reload failure leaves the old forest serving
+                # (the swap happens last inside state.reload); report
+                # it structurally — client faults (missing/corrupt
+                # model) as 4xx, everything else as 5xx — and count it
+                state.metrics.reload_failed()
+                code = (400 if isinstance(
+                    ex, (OSError, log.LightGBMError, BadRequest))
+                    else 500)
+                log.warning("serve: reload failed (%s: %s); old model "
+                            "kept serving" % (type(ex).__name__, ex))
+                self._respond(code, _error_json(ex), "application/json")
+                return code
             self._respond(200, json.dumps(info).encode(),
                           "application/json")
             return 200

@@ -11,6 +11,10 @@
 #                                            every contract class catches
 #                                            its bug class, clean tree
 #                                            stays clean
+#   3. scripts/chaos_smoke.sh (when jax imports): kill-resume round
+#      trip byte-identity, corrupt-snapshot skip, serving overload
+#      shedding, degraded-mode fallback — the fast cousin of the
+#      slow-marked tests/test_chaos.py suite
 #
 # Exit codes:
 #   0  everything that ran is clean
@@ -46,6 +50,15 @@ if command -v python >/dev/null 2>&1 && python -c "import pytest" 2>/dev/null; t
     [ "$p" -ne 0 ] && rc=1
 else
     echo "== pytest: not installed — SKIPPED (lint.sh covered the stdlib gates) =="
+fi
+
+echo "== chaos smoke (kill-resume + overload + degraded mode) =="
+if python -c "import jax" 2>/dev/null; then
+    bash scripts/chaos_smoke.sh
+    c=$?
+    [ "$c" -ne 0 ] && rc=1
+else
+    echo "== jax not importable — chaos_smoke SKIPPED (jax-free lane) =="
 fi
 
 if [ "$rc" -eq 0 ]; then

@@ -12,6 +12,10 @@ here="$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)"
 PY="${PYTHON:-python3}"
 export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# jaxlib 0.4.36's persistent compilation cache corrupts the heap on the
+# CPU backend (see tests/conftest.py); smoke runs don't need
+# cold-compile amortization.
+export LGBM_TPU_NO_COMPILE_CACHE="${LGBM_TPU_NO_COMPILE_CACHE:-1}"
 
 work="$(mktemp -d)"
 server_pid=""
@@ -134,7 +138,26 @@ health = json.loads(urllib.request.urlopen(base + "/healthz",
                                            timeout=60).read())
 if health.get("status") != "ok":
     fail("healthz not ok after reload: %r" % health)
-print("serve_smoke: predict + metrics + reload OK")
+
+# -- reload FAILURE: structured error, counted, old forest keeps serving
+import urllib.error
+try:
+    post("/reload", json.dumps({"model": work + "/no_such_model.txt"}).encode(),
+         "application/json")
+    fail("reload of a missing model did not error")
+except urllib.error.HTTPError as e:
+    if e.code != 400:
+        fail("reload failure status %d, want 400" % e.code)
+    doc = json.loads(e.read())
+    if not doc.get("error") or not doc.get("message"):
+        fail("reload failure body not structured: %r" % doc)
+metrics = urllib.request.urlopen(base + "/metrics", timeout=60).read().decode()
+if "lgbm_serve_reload_failures_total 1" not in metrics:
+    fail("lgbm_serve_reload_failures_total not incremented")
+got = post("/predict", body)
+if got != want_b:
+    fail("old forest not serving after failed reload")
+print("serve_smoke: predict + metrics + reload + reload-failure OK")
 EOF
 rc=$?
 [ "$rc" -eq 0 ] || die "round trip (rc=$rc)"

@@ -1,0 +1,85 @@
+"""Worker for the 2-process tree_learner=data chaos round-trip
+(test_chaos.py::test_multihost_kill_resume_two_process).
+
+Usage: python mh_chaos_worker.py <rank> <nproc> <port> <data> <model_out>
+           <snap_dir> <phase> <faults_spec>
+
+Phases:
+  base    train 10 iterations straight through, save the model
+  kill    snapshot_period=3 + the given fault schedule (both ranks
+          SIGKILL at the same checkpoint.commit hit — a whole-pool
+          preemption); the process dies mid-run by design
+  resume  resume=auto: ranks allgather their valid snapshot iterations,
+          agree on the newest common one, finish the run, save the model
+
+The resume phase exercises the REAL rank-agreement sync (SnapshotManager
+._agree_latest over parallel.dist.process_allgather, which also runs the
+dist.send/dist.recv faultpoints and the collective deadline wrapper).
+base and resume models must be byte-identical.
+"""
+
+import os
+import sys
+
+(rank, nproc, port, data, model_out, snap_dir, phase, faults_spec) = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], sys.argv[7],
+    sys.argv[8] if len(sys.argv) > 8 else "")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+from lightgbm_tpu.resilience import faults  # noqa: E402
+from lightgbm_tpu.resilience.snapshot import SnapshotManager  # noqa: E402
+
+NUM_ITER = 10
+
+if faults_spec:
+    faults.configure(faults_spec)
+
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "data", "num_leaves": "8",
+    "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+    "hist_dtype": "float64", "metric": "",
+    "is_save_binary_file": "false"})
+ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+booster = create_boosting(cfg, ds, obj)
+assert booster._mh_fused and booster._can_fuse(), \
+    "multi-host data-parallel must take the fused sharded path"
+
+mgr = None
+start = 0
+if phase != "base":
+    mgr = SnapshotManager(snap_dir, period=3,
+                          resume="auto" if phase == "resume" else "off",
+                          rank=rank, num_machines=nproc)
+    if phase == "resume":
+        start = mgr.maybe_resume(booster)
+        print("resumed_at=%d" % start)
+
+for _ in range(start, NUM_ITER):
+    booster.train_one_iter(None, None, False)
+    if mgr is not None and mgr.due(booster.iter):
+        mgr.write(booster)
+
+booster.save_model_to_file(-1, True, model_out)
+print("worker %d done phase=%s" % (rank, phase))

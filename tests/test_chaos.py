@@ -1,0 +1,481 @@
+"""Chaos round-trips: preemption-safe training must be BIT-exact.
+
+The contract (ISSUE 8 / ROADMAP production posture): SIGKILL a training
+subprocess at a (seeded-)random iteration, restart with resume=auto, and
+the final model TEXT is byte-identical to the uninterrupted run —
+across plain/bagged/DART/multiclass and a real 2-process
+tree_learner=data run.  Corrupt snapshots (truncated / bit-flipped /
+zero-length) are skipped with a warning naming the file and the reason,
+resuming from the previous valid one.  The snapshot cadence itself adds
+ZERO recompiles at steady state (xla_guard), and every named faultpoint
+is reachable through its real seam.
+
+Subprocess round-trips are marked `slow` (scripts/chaos_smoke.sh runs
+the same round-trip as a fast smoke); the in-process coverage and
+compile-budget tests ride tier-1.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.resilience.snapshot import SnapshotManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SIGKILLED = (-signal.SIGKILL, 137)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# single-host kill-resume round-trips (subprocess CLI, slow tier)
+# ---------------------------------------------------------------------------
+
+def _write_data(tmp_path, objective):
+    rng = np.random.RandomState(3)
+    n = 400
+    x = rng.randn(n, 6)
+    signal_ = x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+    if objective == "multiclass":
+        edges = np.quantile(signal_, [1 / 3, 2 / 3])
+        y = np.digitize(signal_, edges)
+    else:
+        y = (signal_ > 0).astype(int)
+    p = str(tmp_path / ("train_%s.tsv" % objective))
+    with open(p, "w") as f:
+        for i in range(n):
+            f.write("%d\t" % y[i]
+                    + "\t".join("%.6g" % v for v in x[i]) + "\n")
+    return p
+
+
+def _run_cli(args, faults_spec=None, check=True):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "LGBM_TPU_FAULTS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if faults_spec:
+        env["LGBM_TPU_FAULTS"] = faults_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu"] + args,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=600)
+    out = proc.stdout.decode()
+    if check:
+        assert proc.returncode == 0, out
+    return proc.returncode, out
+
+
+CHAOS_CONFIGS = {
+    "binary": {"objective": "binary"},
+    "multiclass": {"objective": "multiclass", "num_class": "3"},
+    "dart": {"objective": "binary", "boosting": "dart",
+             "drop_rate": "0.3"},
+    # period=3 vs bagging_freq=2: snapshots land both ON a re-bagging
+    # boundary (iteration 6) and mid-epoch (3, 9), so resume crosses a
+    # re-bag inside the recovered window
+    "bagged": {"objective": "binary", "bagging_fraction": "0.5",
+               "bagging_freq": "2"},
+}
+
+#: seeded kill iterations, drawn once (np.random.RandomState(8)
+#: .randint(5, 18, 4)) and PINNED so failures reproduce exactly
+KILL_AT = {"binary": 7, "multiclass": 13, "dart": 10, "bagged": 16}
+
+
+def _base_args(data, model, extra):
+    args = ["task=train", "data=" + data, "output_model=" + model,
+            "num_iterations=20", "num_leaves=7", "max_bin=63",
+            "min_data_in_leaf=20", "metric=", "verbose=1"]
+    return args + ["%s=%s" % kv for kv in extra.items()]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(CHAOS_CONFIGS))
+def test_kill_resume_is_byte_exact(tmp_path, name):
+    extra = CHAOS_CONFIGS[name]
+    data = _write_data(tmp_path, extra["objective"])
+    base = str(tmp_path / "base.txt")
+    _run_cli(_base_args(data, base, extra))
+
+    chaos = str(tmp_path / "chaos.txt")
+    snaps = str(tmp_path / "snaps")
+    chaos_args = _base_args(data, chaos, extra) + [
+        "snapshot_period=3", "snapshot_dir=" + snaps, "resume=auto"]
+    # the flush faultpoint fires once per iteration dispatch on this
+    # CPU config (iter_batch=1), so hit N == "mid-iteration N"
+    rc, out = _run_cli(
+        chaos_args,
+        faults_spec="flush.device_get@%d=kill" % KILL_AT[name],
+        check=False)
+    assert rc in SIGKILLED, "expected the injected SIGKILL:\n" + out
+    assert not os.path.exists(chaos), \
+        "a killed run must never commit a (truncated) model file"
+
+    rc, out = _run_cli(chaos_args)
+    assert "Resumed from snapshot" in out
+    assert open(base, "rb").read() == open(chaos, "rb").read(), \
+        "resume=auto diverged from the uninterrupted run (%s)" % name
+
+
+@pytest.mark.slow
+def test_sigterm_flushes_final_snapshot(tmp_path):
+    """Graceful preemption: SIGTERM mid-run writes a snapshot at the
+    next segment boundary and exits 0; resume completes bit-exact."""
+    import threading
+    import time
+
+    data = _write_data(tmp_path, "binary")
+    base = str(tmp_path / "base.txt")
+    _run_cli(_base_args(data, base, {"objective": "binary"}))
+
+    out_model = str(tmp_path / "chaos.txt")
+    snaps = str(tmp_path / "snaps")
+    args = _base_args(data, out_model, {"objective": "binary"}) + [
+        "snapshot_period=5", "snapshot_dir=" + snaps, "resume=auto"]
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu"] + args, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    # SIGTERM once the training loop is demonstrably under way
+    def _term():
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.isdir(snaps) and os.listdir(snaps):
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+
+    t = threading.Thread(target=_term)
+    t.start()
+    out = proc.communicate(timeout=600)[0].decode()
+    t.join()
+    assert proc.returncode == 0, out
+    assert "Preempted at iteration" in out
+    assert os.listdir(snaps), "no snapshot flushed on SIGTERM"
+
+    rc, out = _run_cli(args)
+    assert "Resumed from snapshot" in out
+    assert open(base, "rb").read() == open(out_model, "rb").read()
+
+
+@pytest.mark.slow
+def test_corrupt_snapshots_skipped_end_to_end(tmp_path):
+    """Damage the two NEWEST snapshots two different ways: resume=auto
+    names both rejected files (with the reason), falls back to the
+    previous valid one, and still finishes byte-exact."""
+    data = _write_data(tmp_path, "binary")
+    base = str(tmp_path / "base.txt")
+    _run_cli(_base_args(data, base, {"objective": "binary"}))
+
+    chaos = str(tmp_path / "chaos.txt")
+    snaps = str(tmp_path / "snaps")
+    chaos_args = _base_args(data, chaos, {"objective": "binary"}) + [
+        "snapshot_period=3", "snapshot_dir=" + snaps, "resume=auto"]
+    rc, out = _run_cli(chaos_args,
+                       faults_spec="flush.device_get@11=kill",
+                       check=False)
+    assert rc in SIGKILLED, out
+    names = sorted(os.listdir(snaps))      # iterations 3, 6, 9
+    assert len(names) == 3, names
+    newest = os.path.join(snaps, names[-1])
+    second = os.path.join(snaps, names[-2])
+    raw = open(newest, "rb").read()
+    with open(newest, "wb") as f:          # truncate
+        f.write(raw[:len(raw) // 2])
+    raw = bytearray(open(second, "rb").read())
+    raw[len(raw) // 2] ^= 0x04             # bit flip
+    with open(second, "wb") as f:
+        f.write(bytes(raw))
+
+    rc, out = _run_cli(chaos_args)
+    assert ("Skipping snapshot %s" % newest) in out
+    assert ("Skipping snapshot %s" % second) in out
+    assert out.count("corrupt") >= 2       # each rejection names why
+    assert ("Resumed from snapshot %s" % os.path.join(snaps, names[0])) \
+        in out
+    assert open(base, "rb").read() == open(chaos, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# 2-process tree_learner=data kill-resume (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multihost_kill_resume_two_process(tmp_path):
+    """Whole-pool preemption under tree_learner=data: both ranks die at
+    the same injected checkpoint.commit, restart with resume=auto,
+    agree on the common snapshot iteration via the rank-sync
+    allgather, and finish byte-identical to the uninterrupted run."""
+    import socket as socketlib
+
+    rng = np.random.RandomState(0)
+    n, ncol = 800, 5
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+    worker = os.path.join(os.path.dirname(__file__), "mh_chaos_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "LGBM_TPU_FAULTS")}
+    snaps = str(tmp_path / "snaps")
+
+    def run_phase(phase, faults_spec="", expect_kill=False):
+        s = socketlib.socket()
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+        s.close()
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), "2", port, str(data),
+             str(tmp_path / ("model_%s_%d.txt" % (phase, r))),
+             snaps, phase, faults_spec],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for r in range(2)]
+        logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+        for r, p in enumerate(procs):
+            if expect_kill:
+                assert p.returncode in SIGKILLED, \
+                    "rank %d should have been SIGKILLed:\n%s" \
+                    % (r, logs[r])
+            else:
+                assert p.returncode == 0, \
+                    "rank %d failed:\n%s" % (r, logs[r])
+        return logs
+
+    run_phase("base")
+    # both ranks SIGKILL the instant their SECOND snapshot (iteration
+    # 6) is durable — a whole-pool preemption mid-run
+    run_phase("kill", faults_spec="checkpoint.commit@2=kill",
+              expect_kill=True)
+    logs = run_phase("resume")
+    for r in range(2):
+        assert "resumed_at=6" in logs[r], logs[r]
+        base_m = open(str(tmp_path / ("model_base_%d.txt" % r)),
+                      "rb").read()
+        res_m = open(str(tmp_path / ("model_resume_%d.txt" % r)),
+                     "rb").read()
+        assert base_m == res_m, "rank %d resume diverged" % r
+    assert open(str(tmp_path / "model_resume_0.txt"), "rb").read() \
+        == open(str(tmp_path / "model_resume_1.txt"), "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# snapshot cadence adds zero recompiles (tier-1)
+# ---------------------------------------------------------------------------
+
+def _booster(extra=None):
+    rng = np.random.RandomState(1)
+    n = 400
+    x = rng.randn(n, 6).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 5, "metric": "", **(extra or {})}
+    ds = lgb.Dataset(x, label=y,
+                     params={k: str(v) for k, v in params.items()})
+    cfg = Config.from_params({k: str(v) for k, v in params.items()})
+    obj = create_objective(cfg)
+    obj.init(ds.inner.metadata, ds.inner.num_data)
+    return create_boosting(cfg, ds.inner, obj)
+
+
+def test_snapshot_cadence_zero_recompiles(tmp_path, xla_guard):
+    """Crossing snapshot boundaries at steady state compiles NOTHING:
+    the cadenced save_checkpoint flush reuses the warm executables."""
+    booster = _booster()
+    mgr = SnapshotManager(str(tmp_path), period=2, resume="off")
+    for _ in range(4):                    # warm: spans snapshots @2, @4
+        booster.train_one_iter(None, None, False)
+        if mgr.due(booster.iter):
+            mgr.write(booster)
+    with xla_guard(0, what="snapshot cadence at steady state"):
+        for _ in range(4):                # crosses snapshots @6, @8
+            booster.train_one_iter(None, None, False)
+            if mgr.due(booster.iter):
+                mgr.write(booster)
+    assert len(os.listdir(str(tmp_path))) == 4
+
+
+def test_snapshot_resume_matches_straight_run(tmp_path):
+    """In-process api.train honors snapshot_period/resume: a booster
+    restored via resume=auto finishes bit-identical to the oracle."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(300, 5)
+    y = (x[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": ""}
+    oracle = lgb.train(params, lgb.Dataset(x, label=y),
+                       num_boost_round=8, verbose_eval=False)
+
+    snaps = str(tmp_path / "s")
+    p2 = {**params, "snapshot_period": 3, "snapshot_dir": snaps}
+    lgb.train(p2, lgb.Dataset(x, label=y), num_boost_round=5,
+              verbose_eval=False)        # stops at 5; snapshot at 3
+    assert os.listdir(snaps)
+    resumed = lgb.train({**p2, "resume": "auto"},
+                        lgb.Dataset(x, label=y), num_boost_round=8,
+                        verbose_eval=False)
+    assert resumed._gbdt.iter == 8
+    assert oracle.model_to_string() == resumed.model_to_string()
+
+
+def test_resume_rejects_changed_config(tmp_path, capsys):
+    """Snapshots are bound to the config/dataset that wrote them:
+    resume=auto under changed hyper-parameters skips them as stale
+    (fresh start, bit-identical to a never-snapshotted run), and an
+    explicit resume=<path> refuses outright."""
+    from lightgbm_tpu.utils import log
+
+    rng = np.random.RandomState(6)
+    x = rng.randn(300, 5)
+    y = (x[:, 0] > 0).astype(np.float32)
+    snaps = str(tmp_path / "s")
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": "",
+              "snapshot_period": 3, "snapshot_dir": snaps}
+    lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=5,
+              verbose_eval=False)            # snapshot at iteration 3
+    snap_files = os.listdir(snaps)
+    assert snap_files
+
+    # resume-only manager (period 0): the changed-config run must not
+    # overwrite the 7-leaf snapshot the test is about
+    changed = {**params, "num_leaves": 15, "resume": "auto",
+               "snapshot_period": 0}
+    # the oracle must not touch snapshot_dir: it would overwrite the
+    # 7-leaf snapshot with a 15-leaf one the resumed run could then use
+    oracle = lgb.train({k: v for k, v in changed.items()
+                        if k not in ("resume", "snapshot_period",
+                                     "snapshot_dir")},
+                       lgb.Dataset(x, label=y), num_boost_round=4,
+                       verbose_eval=False)
+    capsys.readouterr()                      # drop pre-test output
+    fresh = lgb.train(changed, lgb.Dataset(x, label=y),
+                      num_boost_round=4, verbose_eval=False)
+    out = capsys.readouterr().out
+    assert "Skipping snapshot" in out and "stale" in out
+    assert "num_leaves" in out               # the moved key is named
+    assert oracle.model_to_string() == fresh.model_to_string()
+
+    explicit = {**params, "num_leaves": 15,
+                "resume": os.path.join(snaps, sorted(snap_files)[0])}
+    with pytest.raises(log.LightGBMError, match="rejected.*stale"):
+        lgb.train(explicit, lgb.Dataset(x, label=y),
+                  num_boost_round=4, verbose_eval=False)
+
+
+def test_resume_honors_lowered_round_count(tmp_path):
+    """Re-capping a run IS the legitimate config change resume permits
+    — but the model must then hold exactly the requested rounds: a
+    snapshot past num_boost_round is skipped and the next one at or
+    below the cap is restored, bit-identical to a straight short run."""
+    rng = np.random.RandomState(8)
+    x = rng.randn(300, 5)
+    y = (x[:, 0] > 0).astype(np.float32)
+    snaps = str(tmp_path / "s")
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": ""}
+    lgb.train({**params, "snapshot_period": 1, "snapshot_dir": snaps},
+              lgb.Dataset(x, label=y), num_boost_round=5,
+              verbose_eval=False)          # snapshots at 1..5
+    oracle = lgb.train(params, lgb.Dataset(x, label=y),
+                       num_boost_round=3, verbose_eval=False)
+    short = lgb.train({**params, "snapshot_period": 0,
+                       "snapshot_dir": snaps, "resume": "auto"},
+                      lgb.Dataset(x, label=y), num_boost_round=3,
+                      verbose_eval=False)  # 4, 5 skipped; resumes at 3
+    assert short._gbdt.iter == 3
+    assert oracle.model_to_string() == short.model_to_string()
+
+
+def test_api_params_arm_fault_schedule(tmp_path):
+    """The `faults` config key injects through api.train too, not only
+    the CLI — API-driven chaos tests must not pass vacuously."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(200, 4)
+    y = (x[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": "",
+              "snapshot_period": 1, "snapshot_dir": str(tmp_path / "s"),
+              "faults": "checkpoint.write@1=raise"}
+    with pytest.raises(faults.FaultInjected):
+        lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=2,
+                  verbose_eval=False)
+    assert faults.fired("checkpoint.write") == 1
+
+
+# ---------------------------------------------------------------------------
+# every faultpoint is reachable through its REAL seam (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_every_faultpoint_reachable(tmp_path):
+    """Drive each registered faultpoint through the production code
+    path that hosts it and prove the seam was crossed (hits > 0) —
+    the closed registry plus this test means a chaos schedule can
+    target every seam and none is dead wiring."""
+    from lightgbm_tpu.parallel import dist
+    from lightgbm_tpu.resilience import net
+    from lightgbm_tpu.resilience.atomic import write_npz
+
+    class _Snap:
+        iter = 4
+
+        def save_checkpoint(self, path):
+            write_npz(path, {"iter": np.int64(4),
+                             "num_trees": np.int64(4),
+                             "scores": np.zeros(2)})
+
+    # checkpoint.write / checkpoint.commit
+    SnapshotManager(str(tmp_path), 2, "off").write(_Snap())
+    # flush.device_get: one real training dispatch + flush
+    b = _booster()
+    b.train_one_iter(None, None, False)
+    b._flush_pending()
+    # dist.connect
+    net.connect_with_retry(lambda: None, "probe", deadline_s=5.0)
+    # dist.send / dist.recv (single-process allgather is still the
+    # real transport entry)
+    out = dist.process_allgather(np.array([7], dtype=np.int64))
+    assert out.reshape(-1)[0] == 7
+    # serve.dispatch: a device-engine forest answering a predict
+    from test_predict_fast import BINARY_MODEL
+    from lightgbm_tpu.serving.forest import ServingForest
+    forest = ServingForest(BINARY_MODEL, backend="jax")
+    forest.predict(np.zeros((2, forest.max_feature_idx + 1)), "raw")
+    # reload.parse: the serving hot-swap entry
+    from lightgbm_tpu.serving.server import ServingState
+    model_path = str(tmp_path / "m.txt")
+    with open(model_path, "w") as f:
+        f.write(BINARY_MODEL)
+    cfg = Config.from_params({"task": "serve",
+                              "input_model": model_path,
+                              "serve_backend": "native"})
+    state = ServingState(cfg, ServingForest(BINARY_MODEL,
+                                            backend="native"))
+    try:
+        state.reload(model_path)
+    finally:
+        state.batcher.shutdown()
+
+    missing = [n for n in faults.KNOWN_FAULTPOINTS
+               if faults.hits(n) == 0]
+    assert not missing, "faultpoints never reached: %s" % missing

@@ -1,0 +1,659 @@
+"""Unit tests for the fault-tolerance subsystem (lightgbm_tpu/resilience/):
+atomic durable writes, deterministic fault injection, hardened network
+helpers, the snapshot manager's cadence/validation/resume policies, and
+the corrupt-binary-cache fallback regression.
+
+Chaos round-trips (SIGKILL + resume=auto byte identity) live in
+test_chaos.py; serving failure paths in test_serving_resilience.py.
+"""
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.resilience import atomic
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.resilience import net
+from lightgbm_tpu.resilience.snapshot import (SnapshotManager,
+                                              snapshot_name,
+                                              validate_snapshot)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# atomic: crash-safe writes + integrity footer
+# ---------------------------------------------------------------------------
+
+class TestAtomic:
+    def test_write_read_roundtrip_with_footer(self, tmp_path):
+        p = str(tmp_path / "a.bin")
+        atomic.atomic_write_bytes(p, b"payload-bytes")
+        assert atomic.read_verified(p) == b"payload-bytes"
+        assert atomic.verify_file(p) == "ok"
+        # the footer is 40 bytes past the payload on disk
+        assert os.path.getsize(p) == len(b"payload-bytes") + atomic.FOOTER_LEN
+
+    @staticmethod
+    def _dead_pid():
+        """A pid that provably belonged to a dead process."""
+        import subprocess
+        import sys
+        proc = subprocess.Popen([sys.executable, "-c", "pass"])
+        proc.wait()
+        return proc.pid
+
+    @staticmethod
+    def _make_stale(path):
+        """Age a tmp past the sweep's quiet threshold."""
+        old = time.time() - atomic.STALE_TMP_S - 60
+        os.utime(path, (old, old))
+
+    def test_stale_tmp_swept_on_next_write(self, tmp_path):
+        # a SIGKILL mid-write orphans a pid-tagged tmp; the NEXT writer
+        # for the same target (a fresh pid after resume) must sweep it,
+        # while leaving other targets' tmps and non-tmp siblings alone
+        p = str(tmp_path / "model.txt")
+        dead_pid = self._dead_pid()
+        stale = "%s.%d.lgtmp" % (p, dead_pid)
+        other = "%s.%d.lgtmp" % (str(tmp_path / "other.txt"), dead_pid)
+        lookalike = p + ".notapid.lgtmp"
+        for f in (stale, other, lookalike):
+            with open(f, "wb") as fh:
+                fh.write(b"orphan")
+            self._make_stale(f)
+        atomic.atomic_write_bytes(p, b"fresh")
+        assert not os.path.exists(stale)
+        assert os.path.exists(other) and os.path.exists(lookalike)
+        assert atomic.read_verified(p) == b"fresh"
+
+    def test_stale_tmp_swept_by_text_writer(self, tmp_path):
+        p = str(tmp_path / "model.txt")
+        stale = "%s.%d.lgtmp" % (p, self._dead_pid())
+        with open(stale, "wb") as fh:
+            fh.write(b"orphan")
+        self._make_stale(stale)
+        w = atomic.text_writer(p)
+        w.write("t\n")
+        w.close()
+        assert not os.path.exists(stale)
+        assert open(p).read() == "t\n"
+
+    def test_live_writer_tmp_never_swept(self, tmp_path):
+        # multi-host ranks may write the SAME target concurrently on a
+        # shared filesystem: a foreign tmp is reaped only when its
+        # writer is provably dead on this host AND it has gone quiet —
+        # a fresh mtime (live local writer or unprobeable cross-host
+        # writer) or a live pid must both protect it
+        p = str(tmp_path / "model.txt")
+        live_fresh = "%s.%d.lgtmp" % (p, self._dead_pid())
+        with open(live_fresh, "wb") as fh:
+            fh.write(b"mid-write")           # fresh mtime: still active
+        live_pid = "%s.%d.lgtmp" % (p, os.getppid())
+        with open(live_pid, "wb") as fh:
+            fh.write(b"mid-write")
+        self._make_stale(live_pid)           # stale but pid is alive
+        atomic.atomic_write_bytes(p, b"fresh")
+        assert os.path.exists(live_fresh)
+        assert os.path.exists(live_pid)
+
+    def test_footerless_file_is_legacy(self, tmp_path):
+        p = str(tmp_path / "legacy.bin")
+        with open(p, "wb") as f:
+            f.write(b"old-format")
+        assert atomic.verify_file(p) == "legacy"
+        assert atomic.read_verified(p) == b"old-format"
+
+    def test_bit_flip_detected(self, tmp_path):
+        p = str(tmp_path / "a.bin")
+        atomic.atomic_write_bytes(p, b"x" * 100)
+        raw = bytearray(open(p, "rb").read())
+        raw[50] ^= 0x40
+        with open(p, "wb") as f:
+            f.write(raw)
+        assert atomic.verify_file(p).startswith("corrupt")
+        with pytest.raises(atomic.IntegrityError):
+            atomic.read_verified(p)
+
+    def test_zero_length_is_corrupt(self, tmp_path):
+        p = str(tmp_path / "z.bin")
+        open(p, "wb").close()
+        assert atomic.verify_file(p) == "corrupt: zero-length file"
+
+    def test_missing_file_is_corrupt_not_raise(self, tmp_path):
+        assert atomic.verify_file(str(tmp_path / "nope")).startswith(
+            "corrupt: unreadable")
+
+    def test_failed_write_leaves_previous_file_and_no_tmp(self, tmp_path):
+        p = str(tmp_path / "a.bin")
+        atomic.atomic_write_bytes(p, b"GOOD")
+        with pytest.raises(RuntimeError, match="mid-write"):
+            with atomic.atomic_writer(p) as f:
+                f.write(b"PARTIAL")
+                raise RuntimeError("mid-write crash")
+        assert atomic.read_verified(p) == b"GOOD"
+        assert [n for n in os.listdir(tmp_path)] == ["a.bin"]
+
+    def test_streaming_checksum_matches_one_shot(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        atomic.atomic_write_bytes(a, b"abcdef" * 1000)
+        with atomic.atomic_writer(b, checksum=True) as f:
+            for _ in range(1000):
+                f.write(b"abcdef")
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+    def test_text_writer_commit_and_abort(self, tmp_path):
+        p = str(tmp_path / "m.txt")
+        w = atomic.text_writer(p)
+        w.write("tree\n")
+        assert not os.path.exists(p)      # nothing visible until commit
+        w.close()
+        assert open(p).read() == "tree\n"
+        w2 = atomic.text_writer(p)
+        w2.write("GARBAGE")
+        w2.abort()
+        assert open(p).read() == "tree\n"  # abort never touches the file
+        assert os.listdir(tmp_path) == ["m.txt"]
+
+    def test_npz_roundtrip_keeps_exact_path(self, tmp_path):
+        p = str(tmp_path / "snap.lgts")    # no .npz suffix on purpose
+        atomic.write_npz(p, {"iter": np.int64(3),
+                             "v": np.arange(5.0)})
+        assert os.path.exists(p)
+        with atomic.read_npz(p) as z:
+            assert int(z["iter"]) == 3
+            np.testing.assert_array_equal(z["v"], np.arange(5.0))
+
+    def test_corrupt_npz_raises_integrity_error(self, tmp_path):
+        p = str(tmp_path / "snap.lgts")
+        atomic.write_npz(p, {"iter": np.int64(3)})
+        raw = bytearray(open(p, "rb").read())
+        raw[10] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(raw)
+        with pytest.raises(atomic.IntegrityError):
+            atomic.read_npz(p)
+
+
+# ---------------------------------------------------------------------------
+# faults: deterministic, seeded injection
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_unknown_faultpoint_rejected(self):
+        with pytest.raises(ValueError, match="unknown faultpoint"):
+            faults.configure("no.such.seam@1=raise")
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.configure("dist.send@1=explode")
+
+    def test_exact_hit_fires_once(self):
+        faults.configure("dist.send@3=raise:boom")
+        faults.faultpoint("dist.send")
+        faults.faultpoint("dist.send")
+        with pytest.raises(faults.FaultInjected, match="boom"):
+            faults.faultpoint("dist.send")
+        faults.faultpoint("dist.send")     # hit 4: rule is exact, no fire
+        assert faults.hits("dist.send") == 4
+        assert faults.fired("dist.send") == 1
+
+    def test_sticky_fires_from_hit_on(self):
+        faults.configure("dist.recv@2+=raise")
+        faults.faultpoint("dist.recv")
+        for _ in range(3):
+            with pytest.raises(faults.FaultInjected):
+                faults.faultpoint("dist.recv")
+        assert faults.fired("dist.recv") == 3
+
+    def test_permille_schedule_is_seed_deterministic(self):
+        def firing_hits(spec):
+            faults.configure(spec)
+            out = []
+            for i in range(200):
+                try:
+                    faults.faultpoint("serve.dispatch")
+                except faults.FaultInjected:
+                    out.append(i)
+            return out
+
+        a = firing_hits("seed=7;serve.dispatch%100=raise")
+        b = firing_hits("seed=7;serve.dispatch%100=raise")
+        c = firing_hits("seed=8;serve.dispatch%100=raise")
+        assert a == b and a                 # reproducible and non-empty
+        assert a != c                       # and actually seed-driven
+
+    def test_unarmed_faultpoint_is_noop(self):
+        faults.faultpoint("reload.parse")
+        assert faults.hits("reload.parse") == 1
+        assert faults.fired("reload.parse") == 0
+
+    def test_env_schedule_picked_up(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "reload.parse@1=raise:from-env")
+        faults.reset()
+        faults._REG._env_checked = False    # simulate fresh process
+        with pytest.raises(faults.FaultInjected, match="from-env"):
+            faults.faultpoint("reload.parse")
+
+    def test_every_known_faultpoint_parses_in_a_schedule(self):
+        spec = ";".join("%s@1000000=raise" % n
+                        for n in faults.KNOWN_FAULTPOINTS)
+        faults.configure(spec)              # closed registry accepts all
+
+
+# ---------------------------------------------------------------------------
+# net: bounded retries, bounded waits, typed errors
+# ---------------------------------------------------------------------------
+
+class TestNet:
+    def test_connect_retry_succeeds_after_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionRefusedError("not up yet")
+            return "linked"
+
+        got = net.connect_with_retry(flaky, "test-connect",
+                                     deadline_s=30.0,
+                                     base_delay_s=0.01, max_delay_s=0.02)
+        assert got == "linked" and calls["n"] == 3
+
+    def test_connect_retry_deadline_raises_typed_error(self):
+        def always_down():
+            raise ConnectionRefusedError("dead coordinator")
+
+        t0 = time.monotonic()
+        with pytest.raises(net.NetworkError,
+                           match="dead coordinator") as ei:
+            net.connect_with_retry(always_down, "test-connect",
+                                   deadline_s=0.2, base_delay_s=0.05,
+                                   max_delay_s=0.1)
+        assert time.monotonic() - t0 < 5.0
+        assert isinstance(ei.value.__cause__, ConnectionRefusedError)
+
+    def test_connect_faultpoint_drives_attempts(self):
+        faults.configure("dist.connect@1=raise:injected-refuse")
+        got = net.connect_with_retry(lambda: "up", "test-connect",
+                                     deadline_s=30.0,
+                                     base_delay_s=0.01)
+        assert got == "up"                 # attempt 2 passes
+        assert faults.hits("dist.connect") == 2
+
+    def test_deadline_passthrough_and_timeout(self):
+        assert net.call_with_deadline(lambda: 41 + 1, 5.0, "quick") == 42
+        assert net.call_with_deadline(lambda: "no-deadline", 0, "x") \
+            == "no-deadline"
+        ev = threading.Event()
+        with pytest.raises(net.NetworkError, match="did not complete"):
+            net.call_with_deadline(lambda: ev.wait(30), 0.1, "dead-peer")
+        ev.set()
+
+    def test_deadline_propagates_callee_error(self):
+        def bad():
+            raise KeyError("inner")
+
+        with pytest.raises(KeyError, match="inner"):
+            net.call_with_deadline(bad, 5.0, "x")
+
+
+# ---------------------------------------------------------------------------
+# snapshot manager: cadence, validation, resume
+# ---------------------------------------------------------------------------
+
+class _FakeBooster:
+    """Minimal save/load_checkpoint carrier for manager-level tests."""
+
+    def __init__(self, iteration=0):
+        self.iter = iteration
+        self.loaded_from = None
+
+    def save_checkpoint(self, path):
+        atomic.write_npz(path, {"iter": np.int64(self.iter),
+                                "num_trees": np.int64(self.iter),
+                                "scores": np.zeros(4)})
+
+    def load_checkpoint(self, path):
+        with atomic.read_npz(path) as z:
+            self.iter = int(z["iter"])
+        self.loaded_from = path
+
+
+def _mgr(tmp_path, period=5, resume="auto", keep=0):
+    return SnapshotManager(str(tmp_path), period, resume, keep=keep)
+
+
+class TestSnapshotManager:
+    def test_due_crosses_period_boundaries(self, tmp_path):
+        m = _mgr(tmp_path, period=5)
+        assert not m.due(4)
+        assert m.due(5)
+        assert m.due(12)                  # segments may jump boundaries
+        m._last = 5
+        assert not m.due(9)
+        assert m.due(10)
+
+    def test_period_zero_never_due(self, tmp_path):
+        m = _mgr(tmp_path, period=0)
+        assert not m.due(10 ** 9)
+
+    def test_write_validate_resume_roundtrip(self, tmp_path):
+        m = _mgr(tmp_path, period=5)
+        m.write(_FakeBooster(5))
+        m.write(_FakeBooster(10))
+        assert validate_snapshot(
+            os.path.join(str(tmp_path), snapshot_name(10))) is None
+        b = _FakeBooster()
+        assert m.maybe_resume(b) == 10
+        assert b.iter == 10
+
+    def test_resume_off_ignores_snapshots(self, tmp_path):
+        m = _mgr(tmp_path, resume="off")
+        m.write(_FakeBooster(5))
+        b = _FakeBooster()
+        assert _mgr(tmp_path, resume="off").maybe_resume(b) == 0
+        assert b.iter == 0
+
+    def test_resume_auto_empty_dir_starts_fresh(self, tmp_path):
+        assert _mgr(tmp_path).maybe_resume(_FakeBooster()) == 0
+
+    def test_resume_explicit_path(self, tmp_path):
+        m = _mgr(tmp_path, period=5)
+        m.write(_FakeBooster(5))
+        path = os.path.join(str(tmp_path), snapshot_name(5))
+        b = _FakeBooster()
+        assert SnapshotManager(str(tmp_path), 0, path).maybe_resume(b) == 5
+        assert b.loaded_from == path
+
+    def test_resume_explicit_corrupt_path_fatals(self, tmp_path):
+        from lightgbm_tpu.utils import log
+        p = str(tmp_path / "bad.lgts")
+        open(p, "wb").close()
+        with pytest.raises(log.LightGBMError, match="rejected"):
+            SnapshotManager(str(tmp_path), 0, p).maybe_resume(
+                _FakeBooster())
+
+    def test_resume_explicit_other_ranks_snapshot_fatals_multihost(
+            self, tmp_path):
+        # a shared conf naming rank 0's snapshot passes _agree's
+        # iteration check on every rank while loading rank 0's SHARD
+        # state everywhere — the silent SPMD divergence must abort
+        # before any collective runs
+        from lightgbm_tpu.utils import log
+        SnapshotManager(str(tmp_path), 5, "auto").write(_FakeBooster(5))
+        path = os.path.join(str(tmp_path), snapshot_name(5, rank=0))
+        mgr = SnapshotManager(str(tmp_path), 0, path, rank=1,
+                              num_machines=2)
+        with pytest.raises(log.LightGBMError, match="ITS OWN"):
+            mgr.maybe_resume(_FakeBooster())
+
+    @pytest.mark.parametrize("damage", ["truncate", "bitflip", "zero"])
+    def test_resume_auto_skips_corrupt_newest(self, tmp_path, damage,
+                                              capsys):
+        m = _mgr(tmp_path, period=5)
+        m.write(_FakeBooster(5))
+        m.write(_FakeBooster(10))
+        newest = os.path.join(str(tmp_path), snapshot_name(10))
+        raw = open(newest, "rb").read()
+        if damage == "truncate":
+            payload = raw[:len(raw) // 2]
+        elif damage == "bitflip":
+            payload = bytearray(raw)
+            payload[len(raw) // 2] ^= 0x01
+        else:
+            payload = b""
+        with open(newest, "wb") as f:
+            f.write(payload)
+        b = _FakeBooster()
+        assert m.maybe_resume(b) == 5, damage
+        assert b.iter == 5
+        out = capsys.readouterr().out
+        assert "Skipping snapshot" in out and snapshot_name(10) in out
+        assert "corrupt" in out            # the reason is named
+
+    def test_missing_required_keys_rejected(self, tmp_path):
+        p = str(tmp_path / snapshot_name(5))
+        atomic.write_npz(p, {"iter": np.int64(5)})
+        reason = validate_snapshot(p)
+        assert reason is not None and "missing key" in reason
+
+    def test_fingerprint_mismatch_is_stale(self, tmp_path):
+        # a snapshot written under a different config/dataset must be
+        # rejected as stale — shape-coincident state would otherwise
+        # silently continue the OLD run under the NEW config
+        fp = "num_leaves=31;learning_rate=0.1"
+        p = str(tmp_path / snapshot_name(5))
+        atomic.write_npz(p, {"iter": np.int64(5),
+                             "num_trees": np.int64(5),
+                             "scores": np.zeros(4),
+                             "resume_fp": np.array(fp)})
+        assert validate_snapshot(p, expect_fp=fp) is None
+        reason = validate_snapshot(
+            p, expect_fp="num_leaves=63;learning_rate=0.1")
+        assert reason is not None and reason.startswith("stale")
+        assert "num_leaves" in reason          # the moved key is named
+        assert "learning_rate" not in reason   # unchanged keys are not
+        # pre-fingerprint snapshots stay loadable (legacy)
+        q = str(tmp_path / snapshot_name(6))
+        atomic.write_npz(q, {"iter": np.int64(6),
+                             "num_trees": np.int64(6),
+                             "scores": np.zeros(4)})
+        assert validate_snapshot(q, expect_fp=fp) is None
+
+    def test_resume_auto_skips_stale_fingerprint(self, tmp_path, capsys):
+        from lightgbm_tpu.resilience.snapshot import resume_fingerprint
+
+        class _CfgBooster(_FakeBooster):
+            def __init__(self, iteration=0, leaves=31):
+                super().__init__(iteration)
+                self.config = type("C", (), {"num_leaves": leaves})()
+
+            def save_checkpoint(self, path):
+                atomic.write_npz(path, {
+                    "iter": np.int64(self.iter),
+                    "num_trees": np.int64(self.iter),
+                    "scores": np.zeros(4),
+                    "resume_fp": np.array(resume_fingerprint(self))})
+
+        m = _mgr(tmp_path, period=5)
+        m.write(_CfgBooster(5, leaves=31))
+        b = _CfgBooster(leaves=63)
+        assert m.maybe_resume(b) == 0          # stale skipped: fresh
+        out = capsys.readouterr().out
+        assert "Skipping snapshot" in out and "stale" in out
+        same = _CfgBooster(leaves=31)
+        assert m.maybe_resume(same) == 5       # matching config resumes
+
+    def test_truncated_archive_without_footer_rejected(self, tmp_path):
+        # legacy (footer-less) snapshot truncated mid-zip: the archive
+        # check must catch what the checksum cannot
+        buf = io.BytesIO()
+        np.savez(buf, iter=np.int64(5), num_trees=np.int64(5),
+                 scores=np.zeros(4))
+        p = str(tmp_path / snapshot_name(5))
+        with open(p, "wb") as f:
+            f.write(buf.getvalue()[:60])
+        reason = validate_snapshot(p)
+        assert reason is not None and "corrupt" in reason
+
+    def test_resume_never_exceeds_iteration_cap(self, tmp_path, capsys):
+        # snapshots from a longer earlier run must not skip the loop
+        # and hand back MORE iterations than this run asked for
+        from lightgbm_tpu.utils import log
+        w = _mgr(tmp_path, period=5)
+        w.write(_FakeBooster(5))
+        w.write(_FakeBooster(10))
+        capped = SnapshotManager(str(tmp_path), 5, "auto",
+                                 max_iteration=7)
+        b = _FakeBooster()
+        assert capped.maybe_resume(b) == 5
+        out = capsys.readouterr().out
+        assert "beyond this run's num_iterations" in out
+        path10 = os.path.join(str(tmp_path), snapshot_name(10))
+        with pytest.raises(log.LightGBMError, match="beyond"):
+            SnapshotManager(str(tmp_path), 0, path10,
+                            max_iteration=7).maybe_resume(_FakeBooster())
+        # exactly AT the cap resumes (the run is simply complete)
+        assert SnapshotManager(str(tmp_path), 0, path10,
+                               max_iteration=10).maybe_resume(
+                                   _FakeBooster()) == 10
+
+    def test_orphan_tmp_sweep_spares_live_writers(self, tmp_path):
+        # the snapshot-dir sweep carries atomic's guard: reap only
+        # provably-dead AND quiet writers of THIS rank — a second live
+        # run sharing the snapshot_dir must not lose its mid-write tmp
+        dead_stale = str(tmp_path / (snapshot_name(3) + ".%d.lgtmp"
+                                     % TestAtomic._dead_pid()))
+        dead_fresh = str(tmp_path / (snapshot_name(4) + ".%d.lgtmp"
+                                     % TestAtomic._dead_pid()))
+        live_stale = str(tmp_path / (snapshot_name(6) + ".%d.lgtmp"
+                                     % os.getppid()))
+        other_rank = str(tmp_path / (snapshot_name(3, rank=1)
+                                     + ".%d.lgtmp"
+                                     % TestAtomic._dead_pid()))
+        for f in (dead_stale, dead_fresh, live_stale, other_rank):
+            with open(f, "wb") as fh:
+                fh.write(b"orphan")
+        for f in (dead_stale, live_stale, other_rank):
+            TestAtomic._make_stale(f)
+        _mgr(tmp_path, period=5, keep=2).write(_FakeBooster(5))
+        assert not os.path.exists(dead_stale)       # reaped
+        assert os.path.exists(dead_fresh)           # still writing?
+        assert os.path.exists(live_stale)           # writer alive
+        assert os.path.exists(other_rank)           # not ours to touch
+
+    def test_retention_prunes_oldest(self, tmp_path):
+        m = _mgr(tmp_path, period=1, keep=2)
+        for i in (1, 2, 3, 4):
+            m.write(_FakeBooster(i))
+        names = sorted(os.listdir(str(tmp_path)))
+        assert names == [snapshot_name(3), snapshot_name(4)]
+
+    def test_rank_files_are_disjoint(self, tmp_path):
+        m0 = SnapshotManager(str(tmp_path), 5, "auto", rank=0)
+        m1 = SnapshotManager(str(tmp_path), 5, "auto", rank=1)
+        m0.write(_FakeBooster(5))
+        m1.write(_FakeBooster(10))
+        assert m0.valid_iters() == [5]
+        assert m1.valid_iters() == [10]
+
+    def test_from_config_validation(self):
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.utils import log
+        cfg = Config.from_params({"snapshot_period": "5",
+                                  "snapshot_dir": "/tmp/x"})
+        assert SnapshotManager.from_config(cfg) is not None
+        off = Config.from_params({})
+        assert SnapshotManager.from_config(off) is None
+        with pytest.raises(log.LightGBMError):
+            Config.from_params({"snapshot_period": "5"})
+        with pytest.raises(log.LightGBMError):
+            Config.from_params({"resume": "auto"})
+
+
+# ---------------------------------------------------------------------------
+# corrupt binary-cache fallback (satellite regression)
+# ---------------------------------------------------------------------------
+
+def _tiny_tsv(tmp_path, n=120, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4)
+    y = (x[:, 0] > 0).astype(int)
+    p = str(tmp_path / "train.tsv")
+    with open(p, "w") as f:
+        for i in range(n):
+            f.write("%d\t" % y[i]
+                    + "\t".join("%.6g" % v for v in x[i]) + "\n")
+    return p
+
+
+class TestCorruptCacheFallback:
+    def _load(self, data, save=False):
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.dataset import load_dataset
+        cfg = Config.from_params({
+            "objective": "binary", "max_bin": 16,
+            "is_save_binary_file": "true" if save else "false"})
+        return load_dataset(data, cfg)
+
+    def test_cache_has_integrity_footer(self, tmp_path):
+        data = _tiny_tsv(tmp_path)
+        self._load(data, save=True)
+        assert atomic.verify_file(data + ".bin") == "ok"
+
+    def test_corrupt_cache_falls_back_to_text(self, tmp_path, capsys):
+        data = _tiny_tsv(tmp_path)
+        want = self._load(data, save=True)
+        # bit-flip INSIDE the payload: the section reader would parse
+        # this "cleanly" into poisoned bins — only the checksum sees it
+        cache = data + ".bin"
+        raw = bytearray(open(cache, "rb").read())
+        raw[len(raw) // 2] ^= 0x10
+        with open(cache, "wb") as f:
+            f.write(raw)
+        got = self._load(data)
+        out = capsys.readouterr().out
+        assert "Failed to load binary cache" in out
+        assert "sha256 mismatch" in out
+        np.testing.assert_array_equal(np.asarray(got.bins),
+                                      np.asarray(want.bins))
+
+    def test_truncated_cache_falls_back_to_text(self, tmp_path, capsys):
+        data = _tiny_tsv(tmp_path)
+        want = self._load(data, save=True)
+        cache = data + ".bin"
+        raw = open(cache, "rb").read()
+        with open(cache, "wb") as f:
+            f.write(raw[:len(raw) // 3])
+        got = self._load(data)
+        assert "Failed to load binary cache" in capsys.readouterr().out
+        np.testing.assert_array_equal(np.asarray(got.bins),
+                                      np.asarray(want.bins))
+
+    def test_corrupt_rows_sidecar_falls_back(self, tmp_path, capsys):
+        """A corrupt `.rows.npz` partition sidecar must NOT silently
+        desync the cluster's row sets: the rank-tagged cache is
+        rejected and the partition re-derives from text."""
+        from lightgbm_tpu import native
+        if native.get_lib() is None:
+            pytest.skip("native toolchain absent (shard lottery)")
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.dataset import load_dataset
+        data = _tiny_tsv(tmp_path, n=200)
+        cfg_save = Config.from_params({
+            "objective": "binary", "max_bin": 16,
+            "is_save_binary_file": "true"})
+        want = load_dataset(data, cfg_save, rank=0, num_shards=2)
+        side = data + ".r0of2.bin.rows.npz"
+        assert os.path.exists(side)
+        raw = bytearray(open(side, "rb").read())
+        raw[len(raw) // 2] ^= 0x08
+        with open(side, "wb") as f:
+            f.write(raw)
+        capsys.readouterr()
+        cfg = Config.from_params({"objective": "binary",
+                                  "max_bin": 16})
+        got = load_dataset(data, cfg, rank=0, num_shards=2)
+        assert "Ignoring rank-tagged binary cache" \
+            in capsys.readouterr().out
+        np.testing.assert_array_equal(got.local_rows, want.local_rows)
+        np.testing.assert_array_equal(np.asarray(got.bins),
+                                      np.asarray(want.bins))
+
+    def test_intact_cache_still_loads(self, tmp_path, capsys):
+        data = _tiny_tsv(tmp_path)
+        want = self._load(data, save=True)
+        got = self._load(data)
+        assert "Failed to load binary cache" not in capsys.readouterr().out
+        np.testing.assert_array_equal(np.asarray(got.bins),
+                                      np.asarray(want.bins))

@@ -1,0 +1,354 @@
+"""Degrade-don't-die serving: admission control (bounded in-flight rows
+→ fast 503 + Retry-After), the device-dispatch circuit breaker (repeated
+failures pin serving to the JAX-free native predictor, reported as
+`degraded`), and /reload failure paths (structured error body, failure
+counter, old forest provably kept serving).
+
+Byte-level contract throughout: every ACCEPTED request returns exactly
+the bytes `task=predict` would have written, overloaded/degraded or not.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.resilience import faults
+
+from test_predict_fast import BINARY_MODEL, _rows
+from test_serving import _tsv_body, _write, cli_predict, get, post, serve
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def post_any(url, path, data, ctype="text/plain"):
+    """POST that returns (status, body, headers) for ANY status —
+    urllib raises on 4xx/5xx, which is exactly what we test here."""
+    req = urllib.request.Request(url + path, data=data,
+                                 headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _metric(url, name):
+    _, body = get(url, "/metrics")
+    for line in body.decode().splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError("metric %s not exported" % name)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_estimate_rows_counts_universal_line_endings():
+    """The pre-parse admission estimate must honor the same line
+    endings splitlines() does — a bare-'\\r' body must not estimate
+    ~0 rows and slip a huge parse past a saturated budget."""
+    from lightgbm_tpu.serving.server import _estimate_rows
+    assert _estimate_rows(b"a\nb\n", False) == 2
+    assert _estimate_rows(b"a\rb\r", False) == 2
+    assert _estimate_rows(b"a\r\nb\r\n", False) == 2
+    assert _estimate_rows(b"", False) == 0
+    assert _estimate_rows(b'{"rows": [[1,2],[3,4]]}', True) == 2
+    assert _estimate_rows(b"[]", True) == 0
+
+
+class TestAdmissionControl:
+    def test_overload_sheds_with_503_retry_after(self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=20)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        with serve(model, serve_max_inflight_rows=8) as srv:
+            # occupy the budget exactly as in-flight handlers would
+            assert srv.state.try_admit(8)
+            st, got, hdrs = post_any(srv.url, "/predict", body)
+            assert st == 503
+            assert hdrs.get("Retry-After") == "1"
+            doc = json.loads(got)
+            assert doc["error"] == "RuntimeError"
+            assert "overloaded" in doc["message"]
+            assert _metric(srv.url,
+                           "lgbm_serve_overload_rejected_total") == 1
+            assert _metric(srv.url, "lgbm_serve_inflight_rows") == 8
+            # budget released: the SAME request is admitted and the
+            # bytes are exactly task=predict's
+            srv.state.release(8)
+            st, got, _ = post_any(srv.url, "/predict", body)
+            assert st == 200 and got == want
+            assert _metric(srv.url, "lgbm_serve_inflight_rows") == 0
+
+    def test_shed_happens_before_parse(self, tmp_path):
+        """The 'fast 503' must actually be fast: while the budget is
+        full, a body that would otherwise be a 400 (invalid JSON) still
+        sheds as 503 — admission runs BEFORE any parse work, so
+        overload never burns parse CPU on requests it rejects."""
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        bad = b'{"rows": [[not json at all'
+        with serve(model, serve_max_inflight_rows=4) as srv:
+            assert srv.state.try_admit(4)      # saturate the budget
+            st, _, hdrs = post_any(srv.url, "/predict", bad,
+                                   ctype="application/json")
+            assert st == 503
+            assert "Retry-After" in hdrs
+            srv.state.release(4)
+            st, got, _ = post_any(srv.url, "/predict", bad,
+                                  ctype="application/json")
+            assert st == 400                   # parse error once admitted
+            assert json.loads(got)["error"] == "BadRequest"
+
+    def test_idle_server_admits_oversized_request(self, tmp_path):
+        """A single request larger than the whole budget still serves
+        (the batcher splits it) — admission only sheds under LOAD."""
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=50)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        with serve(model, serve_max_inflight_rows=8) as srv:
+            st, got, _ = post_any(srv.url, "/predict",
+                                  open(data, "rb").read())
+            assert st == 200 and got == want
+
+    def test_concurrent_overload_all_accepted_bytes_exact(self, tmp_path):
+        """Synthetic overload: more concurrent rows than the budget.
+        Every response is either a correct 200 (bytes == task=predict)
+        or a fast 503 with Retry-After — never a hang, never bad
+        bytes."""
+        import threading
+
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=40)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        results = []
+        lock = threading.Lock()
+
+        def client():
+            st, got, hdrs = post_any(srv.url, "/predict", body)
+            with lock:
+                results.append((st, got, hdrs))
+
+        with serve(model, serve_max_inflight_rows=60,
+                   serve_batch_timeout_ms=20) as srv:
+            threads = [threading.Thread(target=client)
+                       for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            shed = _metric(srv.url, "lgbm_serve_overload_rejected_total")
+        assert len(results) == 12
+        n_ok = 0
+        for st, got, hdrs in results:
+            if st == 200:
+                n_ok += 1
+                assert got == want, "accepted request returned bad bytes"
+            else:
+                assert st == 503
+                assert "Retry-After" in hdrs
+        assert n_ok >= 1                      # someone got served
+        assert shed == 12 - n_ok              # every shed was counted
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker / degraded mode
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_dispatch_failures_degrade_to_native(self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=30)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        with serve(model, serve_backend="jax",
+                   serve_breaker_threshold=3) as srv:
+            assert srv.state.forest.engine == "jax"
+            # every device dispatch fails from the first one on (armed
+            # AFTER startup: the warm-up crosses the same faultpoint)
+            faults.configure("serve.dispatch@1+=raise:device dead")
+            for i in range(4):
+                st, got, _ = post_any(srv.url, "/predict", body)
+                assert st == 200, "request %d failed: %s" % (i, got)
+                assert got == want, \
+                    "native fallback bytes differ from task=predict"
+            # threshold crossed: breaker OPEN, forest pinned to host
+            assert srv.state.degraded
+            assert srv.state.forest.engine == "host"
+            st, doc = get(srv.url, "/healthz")
+            health = json.loads(doc)
+            assert health["status"] == "degraded"
+            assert health["degraded"] is True
+            assert health["model"]["degraded"] is True
+            assert _metric(srv.url, "lgbm_serve_degraded") == 1
+            assert _metric(srv.url,
+                           "lgbm_serve_dispatch_failures_total") >= 3
+            # pinned: no more dispatch attempts -> no new failures
+            n = _metric(srv.url, "lgbm_serve_dispatch_failures_total")
+            st, got, _ = post_any(srv.url, "/predict", body)
+            assert st == 200 and got == want
+            assert _metric(
+                srv.url, "lgbm_serve_dispatch_failures_total") == n
+
+    def test_transient_failure_answers_on_host_without_tripping(
+            self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=25)).decode())
+        want = cli_predict(tmp_path, model, data, "raw")
+        body = open(data, "rb").read()
+        with serve(model, serve_backend="jax",
+                   serve_breaker_threshold=3) as srv:
+            faults.configure("serve.dispatch@1=raise:one-off blip")
+            st, got, _ = post_any(srv.url, "/predict?mode=raw", body)
+            assert st == 200 and got == want    # answered on host
+            st, got, _ = post_any(srv.url, "/predict?mode=raw", body)
+            assert st == 200 and got == want    # device again, healthy
+            assert not srv.state.degraded
+            assert srv.state.forest.engine == "jax"
+            st, doc = get(srv.url, "/healthz")
+            assert json.loads(doc)["status"] == "ok"
+
+    def test_stale_forest_failures_do_not_trip_live_breaker(
+            self, tmp_path):
+        # in-flight batches stay pinned to the pre-/reload forest by
+        # design; its late dispatch failures must not count against —
+        # or trip — the breaker on the fresh live forest (a stale trip
+        # would report `degraded` until the NEXT reload, falsely)
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        err = RuntimeError("stale device dead")
+        with serve(model, serve_backend="jax",
+                   serve_breaker_threshold=2) as srv:
+            stale = srv.state.forest
+            st, _, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": model}).encode())
+            assert st == 200
+            live = srv.state.forest
+            assert live is not stale
+            for _ in range(3):               # past the threshold
+                srv.state._dispatch_failure(stale, err)
+            assert not srv.state.degraded
+            assert not stale.degraded
+            st, doc = get(srv.url, "/healthz")
+            assert json.loads(doc)["status"] == "ok"
+            # the LIVE forest's failures still trip it
+            for _ in range(2):
+                srv.state._dispatch_failure(live, err)
+            assert srv.state.degraded
+            assert live.engine == "host"
+
+    def test_reload_closes_the_breaker(self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=25)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        with serve(model, serve_backend="jax",
+                   serve_breaker_threshold=1) as srv:
+            faults.configure("serve.dispatch@1+=raise:device dead")
+            post_any(srv.url, "/predict", body)
+            assert srv.state.degraded
+            faults.reset()                  # "the device recovered"
+            st, got, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": model}).encode())
+            assert st == 200
+            assert not srv.state.degraded
+            assert srv.state.forest.engine == "jax"
+            st, doc = get(srv.url, "/healthz")
+            assert json.loads(doc)["status"] == "ok"
+            st, got, _ = post_any(srv.url, "/predict", body)
+            assert st == 200 and got == want
+
+
+# ---------------------------------------------------------------------------
+# /reload failure paths
+# ---------------------------------------------------------------------------
+
+class TestReloadFailures:
+    def test_missing_model_structured_4xx_old_forest_serves(
+            self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=20)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        with serve(model) as srv:
+            st, got, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": "/no/such/model.txt"}).encode())
+            assert st == 400
+            doc = json.loads(got)
+            assert doc["error"] in ("FileNotFoundError", "OSError")
+            assert "message" in doc
+            assert _metric(srv.url,
+                           "lgbm_serve_reload_failures_total") == 1
+            assert _metric(srv.url, "lgbm_serve_reloads_total") == 0
+            # the old forest provably keeps serving, byte-exact
+            st, got, _ = post_any(srv.url, "/predict", body)
+            assert st == 200 and got == want
+
+    def test_garbage_model_structured_4xx(self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        bad = _write(tmp_path / "bad.txt", "not a model file\n")
+        with serve(model) as srv:
+            st, got, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": bad}).encode())
+            assert st == 400
+            doc = json.loads(got)
+            assert doc["error"] and doc["message"]
+            assert _metric(srv.url,
+                           "lgbm_serve_reload_failures_total") == 1
+            assert srv.state.forest.source == model   # swap never ran
+
+    def test_injected_parse_crash_is_5xx_old_forest_serves(
+            self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        data = _write(tmp_path / "d.tsv",
+                      _tsv_body(_rows(n=20)).decode())
+        want = cli_predict(tmp_path, model, data, "normal")
+        body = open(data, "rb").read()
+        faults.configure("reload.parse@1=raise:injected parse crash")
+        with serve(model) as srv:
+            st, got, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": model}).encode())
+            assert st == 500
+            doc = json.loads(got)
+            assert doc["error"] == "FaultInjected"
+            assert _metric(srv.url,
+                           "lgbm_serve_reload_failures_total") == 1
+            st, got, _ = post_any(srv.url, "/predict", body)
+            assert st == 200 and got == want
+            # the NEXT reload (fault exhausted) succeeds
+            st, got, _ = post_any(
+                srv.url, "/reload",
+                json.dumps({"model": model}).encode())
+            assert st == 200
+            assert _metric(srv.url, "lgbm_serve_reloads_total") == 1
+
+    def test_client_errors_are_structured_json(self, tmp_path):
+        model = _write(tmp_path / "m.txt", BINARY_MODEL)
+        with serve(model) as srv:
+            st, got, _ = post_any(srv.url, "/predict?mode=bogus",
+                                  b"1\t2\t3\t4\n")
+            assert st == 400
+            doc = json.loads(got)
+            assert doc["error"] == "BadRequest"
+            assert "bogus" in doc["message"]
