@@ -577,14 +577,13 @@ def _serve_model_text(num_trees=SERVE_TREES, num_leaves=SERVE_LEAVES,
     return "\n".join(out)
 
 
-def _serve_round(port_params, bodies, warm_reqs=10):
-    """Start a task=serve subprocess, drive SERVE_CLIENTS closed-loop
-    client threads (1-row requests, keep-alive), return
-    (latencies_s, responses_per_client, wall_s)."""
+def _spawn_serve(params, log_name="bench_serve_server.log"):
+    """Start a task=serve subprocess on a fresh port and wait for
+    /healthz.  Returns (proc, port, log_f); stop with _stop_serve.
+    Shared by the closed-loop round driver and the open-loop leg of the
+    worker-scaling sweep so the spawn/readiness logic cannot drift."""
     import http.client
-    import signal as sig
     import socket
-    import threading
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -593,31 +592,52 @@ def _serve_round(port_params, bodies, warm_reqs=10):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # log to a file, not a PIPE: nothing drains a pipe during the run,
     # so a chatty server would fill it and block mid-benchmark
-    log_path = os.path.join(CACHE, "bench_serve_server.log")
+    log_path = os.path.join(CACHE, log_name)
     log_f = open(log_path, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "lightgbm_tpu", "task=serve",
-         "serve_port=%d" % port, *port_params],
+         "serve_port=%d" % port, *params],
         env=env, stdout=log_f, stderr=subprocess.STDOUT, text=True)
-    try:
-        deadline = time.time() + 120
-        while True:
-            try:
-                c = http.client.HTTPConnection("127.0.0.1", port,
-                                               timeout=5)
-                c.request("GET", "/healthz")
-                if c.getresponse().read():
-                    c.close()
-                    break
-            except OSError:
-                if proc.poll() is not None or time.time() > deadline:
-                    log_f.flush()
-                    with open(log_path) as lf:
-                        tail = lf.read()[-2000:]
-                    raise RuntimeError(
-                        "serve process did not come up:\n" + tail)
-                time.sleep(0.1)
+    deadline = time.time() + 120
+    while True:
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=5)
+            c.request("GET", "/healthz")
+            if c.getresponse().read():
+                c.close()
+                return proc, port, log_f
+        except OSError:
+            if proc.poll() is not None or time.time() > deadline:
+                log_f.flush()
+                with open(log_path) as lf:
+                    tail = lf.read()[-2000:]
+                _stop_serve(proc, log_f)
+                raise RuntimeError(
+                    "serve process did not come up:\n" + tail)
+            time.sleep(0.1)
 
+
+def _stop_serve(proc, log_f):
+    import signal as sig
+    proc.send_signal(sig.SIGTERM)
+    try:
+        proc.wait(30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    log_f.close()
+
+
+def _serve_round(port_params, bodies, warm_reqs=10):
+    """Start a task=serve subprocess, drive SERVE_CLIENTS closed-loop
+    client threads (1-row requests, keep-alive), return
+    (latencies_s, responses_per_client, wall_s)."""
+    import http.client
+    import socket
+    import threading
+
+    proc, port, log_f = _spawn_serve(port_params)
+    try:
         lat = [[] for _ in range(SERVE_CLIENTS)]
         resp = [set() for _ in range(SERVE_CLIENTS)]
         errs = []
@@ -657,12 +677,7 @@ def _serve_round(port_params, bodies, warm_reqs=10):
             raise RuntimeError("serve clients failed: %r" % errs[:3])
         return [v for ls in lat for v in ls], resp, wall
     finally:
-        proc.send_signal(sig.SIGTERM)
-        try:
-            proc.wait(30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-        log_f.close()
+        _stop_serve(proc, log_f)
 
 
 def run_serving_bench():
@@ -708,6 +723,137 @@ def run_serving_bench():
         "serve_clients": SERVE_CLIENTS,
         "serve_rows_per_req": SERVE_ROWS_PER_REQ,
     }
+
+
+SERVE_WORKER_SWEEP = [int(w) for w in os.environ.get(
+    "BENCH_SERVE_WORKERS", "1,4,8").split(",") if w.strip()]
+SERVE_OPEN_RPS = int(os.environ.get("BENCH_SERVE_RPS", 150))
+SERVE_OPEN_SECS = float(os.environ.get("BENCH_SERVE_OPEN_SECS", 5))
+
+
+def _serve_open_loop(port, bodies, want, rps, duration):
+    """Open-loop fixed-RPS load: requests fire on a fixed schedule
+    regardless of completions (no coordinated omission — a stalled
+    server cannot slow the arrival rate), latency measured from each
+    request's SCHEDULED send time.  Byte-equal responses REQUIRED.
+    Returns sorted latencies (s) and the count that missed schedule by
+    > 1 s (overload indicator)."""
+    import http.client
+    import socket
+    import threading
+
+    n = max(1, int(rps * duration))
+    nthreads = min(64, max(8, rps // 5))
+    lat = [[] for _ in range(nthreads)]
+    errs = []
+    t0 = time.monotonic() + 0.25   # everyone agrees on the schedule
+
+    def sender(tid):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            for i in range(tid, n, nthreads):
+                sched = t0 + i / rps
+                now = time.monotonic()
+                if sched > now:
+                    time.sleep(sched - now)
+                conn.request("POST", "/predict",
+                             bodies[i % len(bodies)])
+                out = conn.getresponse().read()
+                done = time.monotonic()
+                if out != want[i % len(bodies)]:
+                    raise RuntimeError(
+                        "open-loop response bytes diverged")
+                lat[tid].append(done - sched)
+            conn.close()
+        except Exception as ex:
+            errs.append(ex)
+
+    ts = [threading.Thread(target=sender, args=(tid,))
+          for tid in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise RuntimeError("open-loop clients failed: %r" % errs[:3])
+    flat = sorted(v for ls in lat for v in ls)
+    lagged = sum(1 for v in flat if v > 1.0)
+    return flat, lagged
+
+
+def run_serving_scale_bench():
+    """Worker-scaling serving bench (serving/frontend.py): closed-loop
+    throughput AND open-loop fixed-RPS p50/p99 at serve_workers in
+    SERVE_WORKER_SWEEP, byte-equal responses required everywhere.  The
+    1-worker row is the single-process PR 2 server (the acceptance
+    baseline for the >= 3x-at-8-workers target)."""
+    os.makedirs(CACHE, exist_ok=True)
+    model = os.path.join(CACHE, "bench_serve_model.txt")
+    if not os.path.exists(model):
+        with open(model, "w") as f:
+            f.write(_serve_model_text())
+    rng = np.random.RandomState(SEED + 13)
+    bodies = []
+    for _ in range(SERVE_CLIENTS):
+        rows = rng.randn(SERVE_ROWS_PER_REQ, N_FEAT)
+        bodies.append("".join(
+            "0\t" + "\t".join("%.6g" % v for v in row) + "\n"
+            for row in rows).encode())
+    common = ["input_model=" + model, "metric_freq=100", "verbose=0",
+              "serve_max_batch_rows=4096", "serve_batch_timeout_ms=2"]
+    out = {"serve_worker_sweep": SERVE_WORKER_SWEEP,
+           "serve_open_rps": SERVE_OPEN_RPS,
+           "serve_ncpu": os.cpu_count()}
+    want_resp = None
+    base_rows_per_s = None
+    for workers in SERVE_WORKER_SWEEP:
+        params = common + ["serve_workers=%d" % workers]
+        lat, resp, wall = _serve_round(params, bodies)
+        # byte parity ACROSS worker counts: every client's single
+        # distinct response must match the 1-worker run's
+        flat = [next(iter(r)) for r in resp]
+        assert all(len(r) == 1 for r in resp), \
+            "responses diverged within a worker sweep round"
+        if want_resp is None:
+            want_resp = flat
+        assert flat == want_resp, \
+            "responses diverged across worker counts"
+        n = SERVE_CLIENTS * SERVE_REQS * SERVE_ROWS_PER_REQ
+        rows_per_s = n / wall
+        if base_rows_per_s is None:
+            base_rows_per_s = rows_per_s
+        lat.sort()
+        tag = "serve_w%d" % workers
+        out[tag + "_rows_per_s"] = round(rows_per_s, 1)
+        out[tag + "_closed_p50_ms"] = round(
+            lat[len(lat) // 2] * 1e3, 3)
+        out[tag + "_closed_p99_ms"] = round(
+            lat[int(len(lat) * 0.99)] * 1e3, 3)
+        out[tag + "_scaling_vs_1"] = round(
+            rows_per_s / base_rows_per_s, 3)
+        # open-loop leg against the SAME server configuration
+        proc, port, log_f = _spawn_serve(
+            params, log_name="bench_serve_open.log")
+        try:
+            open_lat, lagged = _serve_open_loop(
+                port, bodies, want_resp, SERVE_OPEN_RPS,
+                SERVE_OPEN_SECS)
+            out[tag + "_open_p50_ms"] = round(
+                open_lat[len(open_lat) // 2] * 1e3, 3)
+            out[tag + "_open_p99_ms"] = round(
+                open_lat[int(len(open_lat) * 0.99)] * 1e3, 3)
+            out[tag + "_open_lagged"] = lagged
+        finally:
+            _stop_serve(proc, log_f)
+    if len(SERVE_WORKER_SWEEP) > 1:
+        last = SERVE_WORKER_SWEEP[-1]
+        out["serve_worker_speedup"] = \
+            out["serve_w%d_rows_per_s" % last] / base_rows_per_s
+    return out
 
 
 def ensure_ref_binary():
@@ -1058,6 +1204,13 @@ def main():
             extras.update(run_serving_bench())
         except Exception as e:
             extras["serve_error"] = str(e)[:200]
+        # worker-scaling sweep (serving/frontend.py): closed-loop
+        # throughput at 1/4/8 workers + open-loop fixed-RPS p50/p99,
+        # byte-equal responses required across every round
+        try:
+            extras.update(run_serving_scale_bench())
+        except Exception as e:
+            extras["serve_scale_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_PREDICT", "1") != "0":
         if predict_extras is None:

@@ -118,7 +118,7 @@ EXPECTED_JAX_FREE: Tuple[str, ...] = (
     "native/__init__.py",
     "parallel/__init__.py", "parallel/dist.py",
     "serving/__init__.py", "serving/forest.py", "serving/batcher.py",
-    "serving/server.py",
+    "serving/server.py", "serving/fleet.py", "serving/frontend.py",
     "utils/__init__.py", "utils/log.py", "utils/mt19937.py",
     "utils/compile_cache.py",
     # the fault-tolerance layer rides inside the jax-free fast paths

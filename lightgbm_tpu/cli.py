@@ -63,6 +63,13 @@ class Application:
             # warm-model HTTP prediction service (serving/): jax imports
             # lazily inside the forest only when its engine is selected,
             # so serve_backend=native keeps the jax-free startup profile
+            if self.config.serve_workers > 1:
+                # multi-process front-end: the SUPERVISOR stays jax-free
+                # (it only forks and watches); each spawned worker
+                # applies the device platform itself (_worker_main)
+                from .serving.frontend import frontend_forever
+                frontend_forever(self.config)
+                return
             if self.config.serve_backend != "native":
                 self._apply_device_type()
             from .serving.server import serve_forever

@@ -238,6 +238,33 @@ class Config:
     #                                       breaker pins serving to the
     #                                       JAX-free native predictor
     serve_retry_after_s: float = 1.0      # Retry-After on overload 503s
+    serve_workers: int = 1                # SO_REUSEPORT worker processes
+    #                                       (serving/frontend.py): N
+    #                                       processes share one listen
+    #                                       port, each with its own warm
+    #                                       forest; 1 = the in-process
+    #                                       single server
+    serve_matmul: str = "auto"            # auto | on | off: route serve
+    #                                       batches >= serve_matmul_min_rows
+    #                                       through the device matmul
+    #                                       predictor (ops/predict.
+    #                                       predict_leaf_matmul) instead
+    #                                       of the stacked descent; auto
+    #                                       engages on accelerators only
+    #                                       (CPU descent wins there), on
+    #                                       forces (tests/CPU parity)
+    serve_matmul_min_rows: int = 1024     # row threshold for the matmul
+    #                                       route (below it the descent
+    #                                       dispatch is cheaper)
+    serve_models: str = ""                # comma-separated extra model
+    #                                       paths registered in the
+    #                                       multi-model fleet at startup
+    #                                       (serving/fleet.py); reachable
+    #                                       via /predict?model=<path>
+    serve_fleet_max_models: int = 4       # LRU warm-pool capacity: at
+    #                                       most this many forests stay
+    #                                       warm; registered models past
+    #                                       it re-warm on demand
 
     # -- fault tolerance (resilience/) -----------------------------------
     snapshot_period: int = 0              # snapshot every N iterations
@@ -413,6 +440,11 @@ class Config:
         set_int("serve_max_inflight_rows")
         set_int("serve_breaker_threshold")
         set_float("serve_retry_after_s")
+        set_int("serve_workers")
+        set_str("serve_matmul")
+        set_int("serve_matmul_min_rows")
+        set_str("serve_models")
+        set_int("serve_fleet_max_models")
         set_int("snapshot_period")
         set_str("snapshot_dir")
         set_int("snapshot_keep")
@@ -433,6 +465,15 @@ class Config:
             log.fatal("serve_breaker_threshold must be >= 1")
         if c.serve_retry_after_s < 0:
             log.fatal("serve_retry_after_s must be >= 0")
+        if c.serve_workers < 1:
+            log.fatal("serve_workers must be >= 1")
+        if c.serve_matmul not in ("auto", "on", "off"):
+            log.fatal("Unknown serve_matmul %s (expect auto|on|off)"
+                      % c.serve_matmul)
+        if c.serve_matmul_min_rows < 1:
+            log.fatal("serve_matmul_min_rows must be >= 1")
+        if c.serve_fleet_max_models < 1:
+            log.fatal("serve_fleet_max_models must be >= 1")
         if c.snapshot_period < 0:
             log.fatal("snapshot_period must be >= 0")
         if c.snapshot_keep < 0:

@@ -2405,64 +2405,17 @@ class GBDT:
         return pack["mm"]
 
     def _matmul_pack(self, trees, sf, th, tl, lc, rc, max_l, m):
-        """Arrays for the gather-free matmul predictor
-        (ops/predict.predict_leaf_matmul): one-hot feature selection,
-        per-feature threshold rank tables (for host rank_encode) + node
-        rank codes, and per-tree path matrices."""
-        t_cnt = len(trees)
-        # pad the tree count to the scan's block multiple; dummy trees
-        # have an all-zero path and depth[0] = 0, so they argmax to leaf
-        # 0 and are sliced off by the caller
-        t_pad = -(-t_cnt // self.PREDICT_TREE_BLOCK) \
-            * self.PREDICT_TREE_BLOCK
-        ftot = self.max_feature_idx + 1
-        if ftot * t_pad * m > (1 << 26):
-            # wide-feature models would make the one-hot selection
-            # matrix hundreds of MB (e.g. 200k sparse features); the
-            # descent path handles those instead
+        """Device pack for the gather-free matmul predictor
+        (ops/predict.predict_leaf_matmul).  Host-side array construction
+        is SHARED with the serving forest (ops/predict.
+        matmul_host_arrays) so the two packs cannot drift."""
+        from ..ops.predict import matmul_host_arrays
+        host = matmul_host_arrays(trees, sf, th, tl, lc, rc, max_l, m,
+                                  self.max_feature_idx + 1,
+                                  self.PREDICT_TREE_BLOCK)
+        if host is None:
             return None
-        sel = np.zeros((ftot, t_pad * m), dtype=np.float32)
-        real = np.zeros((t_cnt, m), dtype=bool)
-        for i in range(t_cnt):
-            ni = trees[i].num_leaves - 1
-            real[i, :ni] = True
-            for j in range(ni):
-                sel[sf[i, j], i * m + j] = 1.0
-        key = ((th.astype(np.uint64) << np.uint64(32))
-               | tl.astype(np.uint64))            # [T, M] order keys
-        tables = []
-        for f in range(ftot):
-            sel_f = real & (sf == f)
-            tables.append(np.unique(key[sel_f]))
-        if max(len(t) for t in tables) >= 65535:
-            return None   # uint16 codes overflow; descent path instead
-        thr_code = np.zeros(t_pad * m, dtype=np.float32)
-        for i in range(t_cnt):
-            for j in range(trees[i].num_leaves - 1):
-                thr_code[i * m + j] = np.searchsorted(
-                    tables[sf[i, j]], key[i, j], side="left")
-        pos = np.zeros((t_pad, m, max_l), dtype=np.float32)
-        neg = np.zeros((t_pad, m, max_l), dtype=np.float32)
-        depth = np.full((t_pad, max_l), np.inf, dtype=np.float32)
-        depth[t_cnt:, 0] = 0.0
-        for i, t in enumerate(trees):
-            # DFS from the root: child >= 0 is an internal node, ~child
-            # is a leaf (tree.py wire format)
-            stack = [(0, [])] if t.num_leaves > 1 else []
-            if t.num_leaves == 1:
-                depth[i, 0] = 0.0
-            while stack:
-                node, path = stack.pop()
-                for child, sign in ((lc[i, node], 1.0),
-                                    (rc[i, node], -1.0)):
-                    cpath = path + [(node, sign)]
-                    if child < 0:
-                        leaf = ~child
-                        depth[i, leaf] = len(cpath)
-                        for nd, sg in cpath:
-                            (pos if sg > 0 else neg)[i, nd, leaf] = 1.0
-                    else:
-                        stack.append((int(child), cpath))
+        tables, sel, thr_code, pos, neg, depth = host
         return (tables, (jnp.asarray(sel), jnp.asarray(thr_code),
                          jnp.asarray(pos), jnp.asarray(neg),
                          jnp.asarray(depth)))

@@ -166,6 +166,77 @@ def rank_encode(hi: "np.ndarray", lo: "np.ndarray",
     return out
 
 
+def matmul_host_arrays(trees, sf, th, tl, lc, rc, max_l, m, ftot,
+                       tree_block):
+    """Host-side arrays for the gather-free matmul predictor, shared by
+    the batch path (models/gbdt.py _matmul_pack) and the serving forest
+    (serving/forest.py) so the two packs cannot drift: one-hot feature
+    selection, per-feature threshold rank tables (for rank_encode) +
+    node rank codes, and per-tree path matrices.
+
+    trees: the Tree list; sf/th/tl/lc/rc: the [T, M] padded node arrays
+    (split_hi_lo threshold words); ftot: model feature width;
+    tree_block: scan block multiple the tree count pads to.  Returns
+    (tables, sel, thr_code, pos, neg, depth) as numpy arrays, or None
+    when the pack declines (wide-feature selection matrix, uint16 code
+    overflow) and the descent path should serve instead.
+    """
+    import numpy as np
+    t_cnt = len(trees)
+    # pad the tree count to the scan's block multiple; dummy trees
+    # have an all-zero path and depth[0] = 0, so they argmax to leaf
+    # 0 and are sliced off by the caller
+    t_pad = -(-t_cnt // tree_block) * tree_block
+    if ftot * t_pad * m > (1 << 26):
+        # wide-feature models would make the one-hot selection
+        # matrix hundreds of MB (e.g. 200k sparse features); the
+        # descent path handles those instead
+        return None
+    sel = np.zeros((ftot, t_pad * m), dtype=np.float32)
+    real = np.zeros((t_cnt, m), dtype=bool)
+    for i in range(t_cnt):
+        ni = trees[i].num_leaves - 1
+        real[i, :ni] = True
+        for j in range(ni):
+            sel[sf[i, j], i * m + j] = 1.0
+    key = ((th.astype(np.uint64) << np.uint64(32))
+           | tl.astype(np.uint64))            # [T, M] order keys
+    tables = []
+    for f in range(ftot):
+        sel_f = real & (sf == f)
+        tables.append(np.unique(key[sel_f]))
+    if max(len(t) for t in tables) >= 65535:
+        return None   # uint16 codes overflow; descent path instead
+    thr_code = np.zeros(t_pad * m, dtype=np.float32)
+    for i in range(t_cnt):
+        for j in range(trees[i].num_leaves - 1):
+            thr_code[i * m + j] = np.searchsorted(
+                tables[sf[i, j]], key[i, j], side="left")
+    pos = np.zeros((t_pad, m, max_l), dtype=np.float32)
+    neg = np.zeros((t_pad, m, max_l), dtype=np.float32)
+    depth = np.full((t_pad, max_l), np.inf, dtype=np.float32)
+    depth[t_cnt:, 0] = 0.0
+    for i, t in enumerate(trees):
+        # DFS from the root: child >= 0 is an internal node, ~child
+        # is a leaf (tree.py wire format)
+        stack = [(0, [])] if t.num_leaves > 1 else []
+        if t.num_leaves == 1:
+            depth[i, 0] = 0.0
+        while stack:
+            node, path = stack.pop()
+            for child, sign in ((lc[i, node], 1.0),
+                                (rc[i, node], -1.0)):
+                cpath = path + [(node, sign)]
+                if child < 0:
+                    leaf = ~child
+                    depth[i, leaf] = len(cpath)
+                    for nd, sg in cpath:
+                        (pos if sg > 0 else neg)[i, nd, leaf] = 1.0
+                else:
+                    stack.append((int(child), cpath))
+    return tables, sel, thr_code, pos, neg, depth
+
+
 @functools.partial(jax.jit, static_argnames=("tree_block",))
 def predict_leaf_matmul(sel: jax.Array, thr_code: jax.Array,
                         path_pos: jax.Array, path_neg: jax.Array,

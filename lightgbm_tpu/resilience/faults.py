@@ -18,6 +18,7 @@ exercised every seam):
     dist.recv           a cross-process collective completed
     serve.dispatch      the serving forest's device dispatch
     reload.parse        /reload, before parsing the new model
+    frontend.spawn      each front-end worker (re)spawn attempt
 
 Schedule spec (config key `faults=...` or env LGBM_TPU_FAULTS;
 ';'-separated entries):
@@ -52,7 +53,7 @@ from ..utils.mt19937 import Mt19937Random
 KNOWN_FAULTPOINTS: Tuple[str, ...] = (
     "checkpoint.write", "checkpoint.commit", "flush.device_get",
     "dist.connect", "dist.send", "dist.recv",
-    "serve.dispatch", "reload.parse",
+    "serve.dispatch", "reload.parse", "frontend.spawn",
 )
 
 ENV_VAR = "LGBM_TPU_FAULTS"

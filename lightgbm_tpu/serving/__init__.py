@@ -7,16 +7,29 @@ predictor.hpp:82-130); this package is that loop turned into a service:
   forest.py   ServingForest — model text parsed once (shared
               models.tree.parse_model_text reader), flattened to
               contiguous arrays, kept device-resident with bucketed
-              pre-compiled predict dispatches; JAX-free fallback through
-              native.predict_chunk / the numpy descent.
+              pre-compiled predict dispatches; batches of
+              >= serve_matmul_min_rows rows route through the
+              gather-free matmul predictor (ops/predict), byte-
+              identical to the descent; JAX-free fallback through
+              native.predict_chunk / the numpy descent.  Every forest
+              carries an EXPLICIT identity (content sha, instance
+              number) — the batcher key, so reloads can never mix.
   batcher.py  MicroBatcher — coalesces concurrent requests into one
               dispatch under (max_batch_rows, batch_timeout_ms) and
               scatters results back, bit-identical to solo requests.
+  fleet.py    ModelFleet — N hot models behind an LRU warm pool:
+              /predict?model= routing, per-model /reload, A/B and
+              shadow-traffic shapes.
   server.py   stdlib HTTP server: POST /predict, GET /healthz,
               GET /metrics (Prometheus text), POST /reload (atomic hot
               model swap), graceful drain on SIGTERM.
+  frontend.py Frontend — SO_REUSEPORT multi-process scale-out: N
+              worker processes (each a ServingServer with its own warm
+              fleet) share one listen port; SIGTERM fan-out, worker
+              death detection + respawn.
 
-Selected by `task=serve` through the CLI (cli.py / config.py).
+Selected by `task=serve` through the CLI (cli.py / config.py);
+serve_workers > 1 selects the multi-process front-end.
 """
 
 __jax_free__ = True

@@ -11,8 +11,15 @@ model work:
     one `ops.predict.predict_leaf_stacked` dispatch.  Rows pad up to
     power-of-two buckets (`bucket_rows`) and `warm()` pre-compiles every
     bucket up to `serve_max_batch_rows`, so steady-state requests never
-    recompile regardless of batch size.  Score accumulation stays on the
-    host in f64 (boosting order), byte-identical to `task=predict`.
+    recompile regardless of batch size.  Batches of
+    >= serve_matmul_min_rows rows route through the gather-free matmul
+    predictor (`ops.predict.predict_leaf_matmul`, the same kernel and
+    pack builder as the batch predict path) — BASELINE.md measured it
+    >15x over host descent on locally attached TPU — with leaf indices
+    identical to the descent's by construction (exact rank-encoded
+    compares), so the served bytes cannot change with the route.  Score
+    accumulation stays on the host in f64 (boosting order),
+    byte-identical to `task=predict`.
   - host engine (JAX-free fallback, `serve_backend=native` or jax
     unavailable): raw CSV/TSV request text goes through the fused
     native kernel (`native.predict_chunk` — parse -> descend ->
@@ -29,9 +36,11 @@ from __future__ import annotations
 
 __jax_free__ = True
 
+import hashlib
+import itertools
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +50,17 @@ from ..resilience.faults import faultpoint
 from ..utils import log
 
 MODES = ("normal", "raw", "leaf")
+
+# trees per matmul scan block (the batch predictor's constant,
+# models/gbdt.py PREDICT_TREE_BLOCK — the serving pack mirrors it so
+# both sides build the same executable shape)
+MATMUL_TREE_BLOCK = 8
+
+#: process-wide forest instance counter: makes every ServingForest's
+#: identity unique even for byte-identical model text, so batcher keys
+#: can never coalesce rows across a reload boundary (next() on a
+#: count() iterator is atomic under the GIL)
+_INSTANCE_SEQ: Iterator[int] = itertools.count()
 
 # smallest compiled row bucket: tiny interactive requests share one
 # executable instead of compiling per row count
@@ -64,7 +84,8 @@ class ServingForest:
     """
 
     def __init__(self, model_text: str, num_model_predict: int = -1,
-                 backend: str = "auto", source: str = "<string>"):
+                 backend: str = "auto", source: str = "<string>",
+                 matmul: str = "auto", matmul_min_rows: int = 1024):
         header, trees = parse_model_text(model_text)
         self.num_class: int = header["num_class"]
         self.label_idx: int = header["label_index"]
@@ -81,6 +102,15 @@ class ServingForest:
         self.num_models = len(self.trees)
         self.source = source
         self.loaded_at = time.time()
+        # EXPLICIT model identity: content hash + per-process instance
+        # number.  Batcher keys compare forests through __eq__/__hash__
+        # below, so "same bytes, different load" (a reload mid-flight)
+        # can never coalesce into one dispatch, and the sha travels to
+        # /healthz + /metrics so probes can tell WHICH model answers.
+        self.content_sha: str = hashlib.sha256(
+            model_text.encode("utf-8")).hexdigest()
+        self.identity: Tuple[str, int] = (self.content_sha,
+                                          next(_INSTANCE_SEQ))
 
         self._engine = self._pick_engine(backend)
         self._degraded = False          # circuit breaker pinned us to host
@@ -89,8 +119,28 @@ class ServingForest:
         self._native_spec: Optional[Any] = None
         self._native_spec_tried = False
         self._host_pack: Optional[Dict[str, Any]] = None
+        # device matmul routing (serve_matmul / serve_matmul_min_rows):
+        # batches of >= matmul_min_rows rows dispatch through the
+        # gather-free matmul predictor instead of the stacked descent
+        self._matmul_mode = matmul
+        self.matmul_min_rows = int(matmul_min_rows)
+        self._matmul_disabled = False   # breaker stage 1 pins descent
+        self._mm_pack: Optional[Tuple[Any, ...]] = None
+        self._mm_tried = False
         if self._engine == "jax":
             self._build_jax_pack()
+
+    # identity semantics: two forests are "the same batch key" iff they
+    # are the same LOAD of the same bytes — reloads and re-warms always
+    # differ (the instance counter), byte-different models always
+    # differ (the sha)
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, ServingForest):
+            return NotImplemented
+        return self.identity == other.identity
+
+    def __hash__(self) -> int:
+        return hash(self.identity)
 
     # -- engine selection ----------------------------------------------
     @staticmethod
@@ -114,11 +164,15 @@ class ServingForest:
     def degraded(self) -> bool:
         return self._degraded
 
+    @property
+    def matmul_disabled(self) -> bool:
+        return self._matmul_disabled
+
     def degrade(self) -> None:
-        """Circuit breaker: pin this forest to the JAX-free host
-        engine after repeated device-dispatch failures.  One-way until
-        /reload builds a fresh forest; the host packs warm immediately
-        so the next request needs no lazy build."""
+        """Circuit breaker (final stage): pin this forest to the
+        JAX-free host engine after repeated device-dispatch failures.
+        One-way until /reload builds a fresh forest; the host packs
+        warm immediately so the next request needs no lazy build."""
         with self._lock:
             if self._engine != "jax":
                 return
@@ -126,6 +180,14 @@ class ServingForest:
             self._degraded = True
         self._build_host_pack()
         self._native_forest()
+
+    def disable_matmul(self) -> None:
+        """Circuit breaker stage 1: matmul -> descent.  The device
+        engine keeps serving through the stacked-descent route (whose
+        buckets warm() already compiled); a further failure streak
+        takes the degrade() stage down to the host engine."""
+        with self._lock:
+            self._matmul_disabled = True
 
     # -- packed representations ----------------------------------------
     def _flat_arrays(self) -> Tuple[np.ndarray, np.ndarray,
@@ -165,6 +227,61 @@ class ServingForest:
                             for a in (sf, th, tl, lc, rc))
                 self._jax_pack = {"dev": dev, "lv": lv}
         return self._jax_pack
+
+    def _build_mm_pack(self) -> Optional[Tuple[Any, ...]]:
+        """(tables, device arrays) for the gather-free matmul predictor,
+        or None when the pack declines (wide features / uint16 code
+        overflow — ops/predict.matmul_host_arrays, the SAME builder the
+        batch predictor uses, so the two cannot drift)."""
+        if not self._mm_tried:
+            with self._lock:
+                if not self._mm_tried:
+                    import jax.numpy as jnp
+                    from ..ops.predict import matmul_host_arrays
+                    sf, thr, lc, rc, _ = self._flat_arrays()
+                    from ..ops.predict import split_hi_lo
+                    th, tl = split_hi_lo(thr)
+                    max_l = max((tr.num_leaves for tr in self.trees),
+                                default=1)
+                    m = max(1, max_l - 1)
+                    host = matmul_host_arrays(
+                        self.trees, sf, th, tl, lc, rc, max_l, m,
+                        self.max_feature_idx + 1, MATMUL_TREE_BLOCK)
+                    if host is not None:
+                        tables, sel, thr_code, pos, neg, depth = host
+                        self._mm_pack = (tables, tuple(
+                            jnp.asarray(a)
+                            for a in (sel, thr_code, pos, neg, depth)))
+                    self._mm_tried = True
+        return self._mm_pack
+
+    def matmul_enabled(self) -> bool:
+        """Whether the matmul route is in play for this forest at all
+        (engine, config mode, breaker stage 1)."""
+        if self._engine != "jax" or self._matmul_disabled:
+            return False
+        if self._matmul_mode == "off":
+            return False
+        if self._matmul_mode == "on":
+            return True
+        # auto: accelerators only — on CPU the descent's gathers are
+        # cheap and the O(C * T * M) compare work of the matmul form
+        # loses (the batch predictor draws the same line, gbdt.py
+        # _predict_leaves)
+        import jax
+        return jax.default_backend() != "cpu"
+
+    def matmul_routed(self, n: int) -> bool:
+        """Deterministic route decision for an n-row device batch — the
+        breaker asks it post-failure to learn which route failed."""
+        return (n >= self.matmul_min_rows and self.matmul_enabled()
+                and self._build_mm_pack() is not None)
+
+    def matmul_live(self) -> bool:
+        """True when the matmul route is actually dispatching batches
+        (enabled AND the pack built successfully) — the breaker's
+        stage-1 question: is there a matmul stage left to turn off?"""
+        return self.matmul_enabled() and self._mm_pack is not None
 
     @contract.jax_free
     def _build_host_pack(self) -> Dict[str, Any]:
@@ -209,25 +326,46 @@ class ServingForest:
             x = x[:, :want]
         return x
 
-    def _leaves(self, x: np.ndarray,
-                engine: Optional[str] = None) -> np.ndarray:
+    def _leaves(self, x: np.ndarray, engine: Optional[str] = None,
+                route: Optional[str] = None) -> np.ndarray:
         """[N, F] f64 -> [N, T] leaf indices, one dispatch (JAX engine)
         or the vectorized numpy descent (host engine) — identical f64
         `value <= threshold` routing either way.  `engine` overrides
         the forest's engine for THIS call (the circuit breaker answers
-        a failed device dispatch on the host path)."""
+        a failed device dispatch on the host path); `route` pins the
+        device kernel ('matmul' | 'descent') for warm-up and the
+        breaker's stage-1 fallback — by default batches of
+        >= matmul_min_rows rows take the gather-free matmul predictor
+        (exact rank-encoded compares: leaf indices are IDENTICAL to the
+        descent's, tests pin the served bytes)."""
         n = x.shape[0]
         if (engine or self._engine) == "jax":
             # the device dispatch is a real failure seam (remote TPU
             # tunnel, OOM, backend death): chaos schedules fail it here
             faultpoint("serve.dispatch")
             import jax.numpy as jnp
-            from ..ops.predict import predict_leaf_stacked, split_hi_lo
-            pack = self._build_jax_pack()
+            from ..ops.predict import (predict_leaf_matmul,
+                                       predict_leaf_stacked, rank_encode,
+                                       split_hi_lo)
+            use_mm = (self.matmul_routed(n) if route is None
+                      else route == "matmul")
             b = bucket_rows(n)
             if b > n:
                 x = np.pad(x, ((0, b - n), (0, 0)))
             xh, xl = split_hi_lo(x)
+            if use_mm:
+                mm = self._build_mm_pack()
+                assert mm is not None   # matmul_routed/warm checked
+                tables, mm_dev = mm
+                code = rank_encode(xh, xl, tables)
+                leaves = predict_leaf_matmul(
+                    *mm_dev, jnp.asarray(code),
+                    tree_block=MATMUL_TREE_BLOCK)
+                # dummy block-padding trees slice off; int64 matches the
+                # host descent's dtype so formatted bytes cannot differ
+                return np.asarray(leaves)[:n, :self.num_models] \
+                    .astype(np.int64)
+            pack = self._build_jax_pack()
             leaves = predict_leaf_stacked(*pack["dev"], jnp.asarray(xh),
                                           jnp.asarray(xl))
             return np.asarray(leaves)[:n]
@@ -237,12 +375,14 @@ class ServingForest:
         return out
 
     def predict(self, x: np.ndarray, mode: str,
-                engine: Optional[str] = None) -> np.ndarray:
+                engine: Optional[str] = None,
+                route: Optional[str] = None) -> np.ndarray:
         """Batch predict on parsed rows.  mode 'leaf' -> [N, T] int;
         'raw'/'normal' -> [K, N] f64 (normal applies sigmoid/softmax,
         the exact GBDT.predict expressions).  `engine` forces one
-        engine for this call (circuit-breaker fallback); bytes are
-        identical either way (tests pin host-vs-jax parity)."""
+        engine for this call (circuit-breaker fallback); `route` pins
+        the device kernel (matmul | descent).  Bytes are identical on
+        every engine and route (tests pin the parity)."""
         if mode not in MODES:
             raise ValueError("unknown predict mode %r" % mode)
         eng = engine or self._engine
@@ -253,11 +393,11 @@ class ServingForest:
         if mode == "leaf":
             if n == 0 or t == 0:
                 return np.zeros((n, t), dtype=np.int64)
-            return self._leaves(x, eng)
+            return self._leaves(x, eng, route)
         if n == 0 or t == 0:
             raw = np.zeros((k, n), dtype=np.float64)
         else:
-            leaves = self._leaves(x, eng)
+            leaves = self._leaves(x, eng, route)
             lv = (self._build_jax_pack() if eng == "jax"
                   else self._build_host_pack())["lv"]
             raw = np.zeros((k, n), dtype=np.float64)
@@ -301,8 +441,12 @@ class ServingForest:
     def warm(self, max_batch_rows: int) -> int:
         """Pre-compile every power-of-two row bucket up to
         max_batch_rows (JAX engine; the host engine just builds its
-        packs).  Returns the number of compiled buckets so callers can
-        log/measure."""
+        packs).  Buckets at or above the matmul threshold compile BOTH
+        routes — the matmul executable that serves them and the descent
+        executable the breaker's stage-1 fallback answers on — so
+        steady state stays at zero recompiles even mid-degrade.
+        Returns the number of compiled (bucket, route) executables so
+        callers can log/measure."""
         if self._engine != "jax":
             self._build_host_pack()
             self._native_forest()
@@ -310,10 +454,15 @@ class ServingForest:
         n_buckets = 0
         b = BUCKET_FLOOR
         while True:
-            dummy = np.zeros((min(b, max_batch_rows),
-                              self.max_feature_idx + 1))
+            rows = min(b, max_batch_rows)
+            dummy = np.zeros((rows, self.max_feature_idx + 1))
             self.predict(dummy, "raw")
             n_buckets += 1
+            if self.matmul_routed(rows):
+                # the auto route above took matmul: pre-compile the
+                # descent executable for the same bucket too
+                self.predict(dummy, "raw", route="descent")
+                n_buckets += 1
             if b >= max_batch_rows:
                 break
             b <<= 1
@@ -323,8 +472,16 @@ class ServingForest:
     def info(self) -> Dict[str, Any]:
         return {
             "source": self.source,
+            "sha": self.content_sha,
             "engine": self._engine,
             "degraded": self._degraded,
+            # pack-build is lazy: before the first routed batch this
+            # reports the config/breaker state; once tried, whether the
+            # pack actually accepted the model
+            "matmul": (self.matmul_enabled()
+                       and (self._mm_pack is not None
+                            or not self._mm_tried)),
+            "matmul_min_rows": self.matmul_min_rows,
             "num_models": self.num_models,
             "num_class": self.num_class,
             "max_feature_idx": self.max_feature_idx,
@@ -333,11 +490,13 @@ class ServingForest:
 
 
 def load_forest(path: str, num_model_predict: int = -1,
-                backend: str = "auto") -> ServingForest:
+                backend: str = "auto", matmul: str = "auto",
+                matmul_min_rows: int = 1024) -> ServingForest:
     """Read + parse + pack a model file (no warm-up; callers warm)."""
     with open(path) as f:
         text = f.read()
     if not text.strip():
         log.fatal("Model file %s is empty" % path)
     return ServingForest(text, num_model_predict=num_model_predict,
-                         backend=backend, source=path)
+                         backend=backend, source=path, matmul=matmul,
+                         matmul_min_rows=matmul_min_rows)

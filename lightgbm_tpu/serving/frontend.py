@@ -1,0 +1,315 @@
+"""SO_REUSEPORT multi-process serving front-end.
+
+The single ServingServer is a stdlib HTTP loop behind the GIL: one
+process tops out near one core no matter how many handler threads it
+spawns.  Production RPS wants processes.  This module runs N worker
+processes (`serve_workers`), each the EXISTING ServingServer with its
+own warm forest/fleet, all bound to ONE listen port with SO_REUSEPORT —
+the kernel load-balances accepted connections across the workers, so no
+userspace proxy hop and no shared accept lock.
+
+Workers are plain subprocesses running `python -m
+lightgbm_tpu.serving.frontend <cfg.json> <idx> <port>` — a fresh
+interpreter per worker (no forked JAX runtime state; each worker warms
+its own device forest), independent of how the supervisor itself was
+started (CLI, pytest, embedding).
+
+Supervisor duties:
+  - pick/reserve the port (serve_port=0 resolves once, workers inherit)
+  - spawn workers and detect death + respawn (the `frontend.spawn`
+    faultpoint makes spawn failures chaos-testable; a crash loop backs
+    off instead of spinning hot)
+  - fan SIGTERM/SIGINT out to every worker and wait for each one's
+    graceful drain, so no in-flight request is dropped at shutdown
+
+Each worker tags its /healthz and /metrics with its (index, pid) —
+repeated scrapes land on different workers (SO_REUSEPORT picks per
+connection), so a prober sees the whole fleet's liveness.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config import Config
+from ..resilience.faults import faultpoint
+from ..utils import log
+
+#: a worker that dies within this many seconds of its spawn is crash-
+#: looping: respawns back off instead of spinning hot
+CRASH_LOOP_S = 2.0
+RESPAWN_BACKOFF_S = 0.5
+RESPAWN_BACKOFF_MAX_S = 30.0
+#: consecutive fast deaths per slot before the supervisor gives up —
+#: but ONLY while NO worker has ever run stably (a broken model/config
+#: at startup should exit with the diagnostic, like the single-process
+#: server does; once the fleet has been healthy, respawns retry forever)
+STARTUP_CRASH_LIMIT = 3
+
+#: repo/package parent directory — prepended to the workers' PYTHONPATH
+#: so `python -m lightgbm_tpu.serving.frontend` resolves even when the
+#: supervisor itself ran from a source checkout without installation
+_PKG_PARENT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _worker_main(cfg: Config, idx: int, port: int) -> None:
+    """Body of one front-end worker process (fresh interpreter, so this
+    re-applies the per-process setup the CLI would have done — log
+    level, fault schedule, device platform)."""
+    log.set_level_from_verbosity(cfg.verbose)
+    if cfg.faults:
+        from ..resilience.faults import configure
+        configure(cfg.faults)
+    if cfg.serve_backend != "native" and cfg.device_type == "cpu":
+        # mirror cli.Application._apply_device_type: must run before
+        # any JAX backend initializes in this fresh process
+        import jax
+        # graftlint: disable=GL007 -- _worker_main IS a process entry
+        # point (spawned fresh): it re-applies the CLI's device_type in
+        # its own interpreter before any backend initializes, exactly
+        # like cli.Application._apply_device_type does for task=serve
+        jax.config.update("jax_platforms", "cpu")
+    from .server import ServingServer, run_until_signal
+    cfg = dataclasses.replace(cfg, serve_port=port)
+    server = ServingServer(cfg, reuse_port=True, worker_index=idx)
+    log.info("serve worker %d (pid %d) listening on port %d"
+             % (idx, os.getpid(), port))
+    run_until_signal(server)
+
+
+def worker_entry(argv: List[str]) -> int:
+    """`python -m lightgbm_tpu.serving.frontend <cfg.json> <idx>
+    <port>` — the subprocess entry the supervisor spawns."""
+    if len(argv) != 3:
+        log.warning("usage: python -m lightgbm_tpu.serving.frontend "
+                    "<cfg.json> <worker_idx> <port>")
+        return 2
+    with open(argv[0]) as f:
+        cfg = Config(**json.load(f))
+    _worker_main(cfg, int(argv[1]), int(argv[2]))
+    return 0
+
+
+class Frontend:
+    """Supervisor for N SO_REUSEPORT ServingServer worker processes."""
+
+    def __init__(self, cfg: Config):
+        if cfg.serve_workers < 2:
+            raise ValueError("Frontend wants serve_workers >= 2; use "
+                             "ServingServer for a single process")
+        if not hasattr(socket, "SO_REUSEPORT"):
+            log.fatal("serve_workers > 1 needs SO_REUSEPORT, which "
+                      "this platform does not provide")
+        self.cfg = cfg
+        self.num_workers = int(cfg.serve_workers)
+        # supervision runs on the main thread; the lock makes the
+        # worker-table/drain-flag stores safe against embedding callers
+        # (and keeps the serving lock discipline uniform, GL006)
+        self._lock = threading.Lock()
+        self._workers: List[Optional[subprocess.Popen]] = \
+            [None] * self.num_workers
+        self._spawned_at: List[float] = [0.0] * self.num_workers
+        self._fast_deaths: List[int] = [0] * self.num_workers
+        self._ever_stable = False
+        self._draining = False
+        self._reserve: Optional[socket.socket] = None
+        self._cfg_path: Optional[str] = None
+        self.port = cfg.serve_port
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        """Reserve the port, persist the worker config, spawn every
+        worker."""
+        # bound-but-not-listening + SO_REUSEPORT reserves the port for
+        # the workers without joining the kernel's accept distribution
+        # (only LISTENING sockets receive connections)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((self.cfg.serve_host, self.cfg.serve_port))
+        fd, cfg_path = tempfile.mkstemp(prefix="lgbm_serve_cfg_",
+                                        suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            json.dump(dataclasses.asdict(self.cfg), f)
+        with self._lock:
+            self._reserve = s
+            self.port = s.getsockname()[1]
+            self._cfg_path = cfg_path
+        for idx in range(self.num_workers):
+            self._spawn(idx)
+        log.info("Front-end: %d workers on http://%s:%d (pids %s)"
+                 % (self.num_workers, self.cfg.serve_host, self.port,
+                    ",".join(str(p.pid) for p in self._workers
+                             if p is not None)))
+
+    def _spawn(self, idx: int) -> None:
+        # the spawn seam is chaos-testable: a schedule can fail the
+        # Nth (re)spawn to prove the supervisor survives and retries
+        faultpoint("frontend.spawn")
+        assert self._cfg_path is not None
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (_PKG_PARENT + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.serving.frontend",
+             self._cfg_path, str(idx), str(self.port)],
+            env=env)
+        with self._lock:
+            self._workers[idx] = proc
+            self._spawned_at[idx] = time.monotonic()
+
+    def worker_pids(self) -> List[int]:
+        return [p.pid for p in self._workers if p is not None]
+
+    # -- supervision -----------------------------------------------------
+    def _monitor_once(self, timeout: float = 1.0) -> None:
+        """Poll the workers; respawn what died (unless draining).  A
+        worker that died right after its spawn is crash-looping — back
+        off EXPONENTIALLY so a broken model/config does not spin the
+        host at 100% respawning, and if the fleet has NEVER been stable
+        (no worker outlived CRASH_LOOP_S) give up after
+        STARTUP_CRASH_LIMIT strikes per slot: a typo'd input_model
+        should exit with the worker's diagnostic, exactly like the
+        single-process server does."""
+        died = False
+        for idx, proc in enumerate(list(self._workers)):
+            if proc is None or self._draining:
+                continue
+            code = proc.poll()
+            if code is None:
+                if time.monotonic() - self._spawned_at[idx] \
+                        >= CRASH_LOOP_S:
+                    with self._lock:
+                        self._fast_deaths[idx] = 0
+                        self._ever_stable = True
+                continue
+            died = True
+            fast = (time.monotonic() - self._spawned_at[idx]
+                    < CRASH_LOOP_S)
+            log.warning("serve worker %d (pid %s) died (exit %s)%s — "
+                        "respawning"
+                        % (idx, proc.pid, code,
+                           " after a crash-loop backoff" if fast
+                           else ""))
+            if fast:
+                with self._lock:
+                    self._fast_deaths[idx] += 1
+                    strikes = self._fast_deaths[idx]
+                    hopeless = not self._ever_stable and all(
+                        n >= STARTUP_CRASH_LIMIT
+                        for n in self._fast_deaths)
+                if hopeless:
+                    log.fatal(
+                        "every serve worker crash-looped %d times at "
+                        "startup (see the worker diagnostics above) — "
+                        "giving up instead of respawning forever"
+                        % STARTUP_CRASH_LIMIT)
+                time.sleep(min(
+                    RESPAWN_BACKOFF_S * (2 ** (strikes - 1)),
+                    RESPAWN_BACKOFF_MAX_S))
+            try:
+                self._spawn(idx)
+            except Exception as ex:
+                # an injected (or real) spawn failure: keep the rest of
+                # the fleet serving, retry this slot on the next sweep
+                with self._lock:
+                    self._workers[idx] = None
+                log.warning("serve worker %d respawn failed (%s: %s); "
+                            "retrying" % (idx, type(ex).__name__, ex))
+        if not died:
+            time.sleep(timeout)
+
+    def _sweep_empty_slots(self) -> None:
+        if self._draining:
+            return
+        for idx, proc in enumerate(self._workers):
+            if proc is None:
+                try:
+                    self._spawn(idx)
+                except Exception as ex:
+                    log.warning("serve worker %d respawn failed "
+                                "(%s: %s); retrying"
+                                % (idx, type(ex).__name__, ex))
+
+    def shutdown(self, drain_timeout: float = 30.0) -> None:
+        """SIGTERM fan-out + graceful join: every worker drains its
+        in-flight requests (ServingServer.shutdown inside the worker);
+        stragglers past the timeout are killed."""
+        with self._lock:
+            self._draining = True
+        for proc in self._workers:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()   # SIGTERM: worker drains
+                except OSError:
+                    pass
+        deadline = time.monotonic() + drain_timeout
+        for proc in self._workers:
+            if proc is None:
+                continue
+            try:
+                proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                log.warning("serve worker pid %s did not drain in %gs; "
+                            "killing" % (proc.pid, drain_timeout))
+                proc.kill()
+                try:
+                    proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        if self._reserve is not None:
+            self._reserve.close()
+            with self._lock:
+                self._reserve = None
+        if self._cfg_path is not None:
+            try:
+                os.unlink(self._cfg_path)
+            except OSError:
+                pass
+            with self._lock:
+                self._cfg_path = None
+
+    def run_forever(self) -> None:
+        """Supervise until SIGTERM/SIGINT, then fan out the drain."""
+        stop = threading.Event()
+
+        def _on_signal(signum: int, frame: Any) -> None:
+            log.info("Signal %d: draining %d workers..."
+                     % (signum, self.num_workers))
+            stop.set()
+
+        prev: Dict[int, Any] = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, _on_signal)
+        try:
+            while not stop.is_set():
+                self._monitor_once(timeout=0.5)
+                self._sweep_empty_slots()
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            self.shutdown()
+            log.info("Front-end drained, exiting")
+
+
+def frontend_forever(cfg: Config) -> None:
+    """CLI entry (task=serve with serve_workers > 1)."""
+    fe = Frontend(cfg)
+    fe.start()
+    fe.run_forever()
+
+
+if __name__ == "__main__":   # pragma: no cover - subprocess entry
+    sys.exit(worker_entry(sys.argv[1:]))

@@ -30,7 +30,9 @@ from __future__ import annotations
 __jax_free__ = True
 
 import json
+import os
 import signal
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -45,6 +47,7 @@ from ..io.parser import parse_predict_rows, sniff_format
 from ..resilience.faults import faultpoint
 from ..utils import log
 from .batcher import BatcherClosed, MicroBatcher, RowsPayload, TextPayload
+from .fleet import ModelFleet, UnknownModelError
 from .forest import MODES, ServingForest, load_forest
 
 MAX_BODY_BYTES = 256 << 20   # refuse absurd request bodies outright
@@ -98,6 +101,10 @@ class Metrics:
         self._lock = threading.Lock()
         self.started_at = time.time()
         self.requests: Dict[Tuple[str, int], int] = {}
+        # per-model predict accounting, keyed (source, sha12): fleet
+        # probes and dashboards can tell WHICH model served the traffic
+        self.model_requests: Dict[Tuple[str, str], int] = {}
+        self.model_rows: Dict[Tuple[str, str], int] = {}
         self.rows_total = 0
         self.batches_total = 0
         self.reloads_total = 0
@@ -116,13 +123,19 @@ class Metrics:
                 self.in_flight += 1
 
     def request_finished(self, endpoint: str, code: int,
-                         seconds: float, rows: int = 0) -> None:
+                         seconds: float, rows: int = 0,
+                         model: Optional[Tuple[str, str]] = None) -> None:
         with self._lock:
             if endpoint == "/predict":
                 self.in_flight -= 1
             key = (endpoint, code)
             self.requests[key] = self.requests.get(key, 0) + 1
             self.rows_total += rows
+            if model is not None:
+                self.model_requests[model] = \
+                    self.model_requests.get(model, 0) + 1
+                self.model_rows[model] = \
+                    self.model_rows.get(model, 0) + rows
             if endpoint == "/predict" and code == 200:
                 self.latency.observe(seconds)
 
@@ -148,7 +161,13 @@ class Metrics:
             self.overload_rejected_total += 1
 
     def render(self, forest: ServingForest, degraded: bool = False,
-               inflight_rows: int = 0) -> bytes:
+               inflight_rows: int = 0,
+               models: Optional[List[Dict[str, Any]]] = None,
+               worker: Optional[Tuple[int, int]] = None) -> bytes:
+        """Prometheus text.  `forest` is the DEFAULT model (its gauges
+        keep their historical unlabeled names); `models` is the fleet
+        listing (per-model labeled series); `worker` is (index, pid)
+        when this process runs behind the multi-process front-end."""
         out: List[str] = []
         with self._lock:
             out.append("# HELP lgbm_serve_requests_total "
@@ -161,6 +180,18 @@ class Metrics:
                        "prediction rows served")
             out.append("# TYPE lgbm_serve_rows_total counter")
             out.append("lgbm_serve_rows_total %d" % self.rows_total)
+            out.append("# HELP lgbm_serve_model_requests_total "
+                       "predict requests by served model")
+            out.append("# TYPE lgbm_serve_model_requests_total counter")
+            for (src, sha), n in sorted(self.model_requests.items()):
+                out.append('lgbm_serve_model_requests_total'
+                           '{model="%s",sha="%s"} %d' % (src, sha, n))
+            out.append("# HELP lgbm_serve_model_rows_total "
+                       "prediction rows by served model")
+            out.append("# TYPE lgbm_serve_model_rows_total counter")
+            for (src, sha), n in sorted(self.model_rows.items()):
+                out.append('lgbm_serve_model_rows_total'
+                           '{model="%s",sha="%s"} %d' % (src, sha, n))
             out.append("# HELP lgbm_serve_batches_total "
                        "coalesced predict dispatches")
             out.append("# TYPE lgbm_serve_batches_total counter")
@@ -212,6 +243,35 @@ class Metrics:
                        "tree count of the live model")
             out.append("# TYPE lgbm_serve_model_num_trees gauge")
             out.append("lgbm_serve_model_num_trees %d" % forest.num_models)
+            if models:
+                # fleet identity: one series per WARM model, labeled
+                # with path + content sha so dashboards can tell which
+                # model each worker actually serves
+                out.append("# HELP lgbm_serve_fleet_model_loaded_"
+                           "timestamp_seconds unix load time per warm "
+                           "fleet model")
+                out.append("# TYPE lgbm_serve_fleet_model_loaded_"
+                           "timestamp_seconds gauge")
+                for doc in models:
+                    if not doc.get("warm"):
+                        continue
+                    out.append(
+                        'lgbm_serve_fleet_model_loaded_timestamp_seconds'
+                        '{model="%s",sha="%s",default="%d"} %.17g'
+                        % (doc["source"], str(doc["sha"])[:12],
+                           int(bool(doc.get("default"))),
+                           doc["loaded_at"]))
+            if worker is not None:
+                # multi-process front-end: which worker answered this
+                # scrape, and that it is alive — repeated scrapes land
+                # on different workers (SO_REUSEPORT picks per
+                # connection), so a prober sees the whole fleet
+                out.append("# HELP lgbm_serve_worker front-end worker "
+                           "liveness (the worker that answered this "
+                           "scrape)")
+                out.append("# TYPE lgbm_serve_worker gauge")
+                out.append('lgbm_serve_worker{index="%d",pid="%d"} 1'
+                           % worker)
             self.latency.render("lgbm_serve_request_latency_seconds",
                                 "predict request latency", out)
             self.batch_rows.render("lgbm_serve_batch_rows",
@@ -313,10 +373,12 @@ def _estimate_rows(body: bytes, is_json: bool) -> int:
 # ---------------------------------------------------------------------------
 
 class ServingState:
-    def __init__(self, cfg: Config, forest: ServingForest):
+    def __init__(self, cfg: Config, forest: ServingForest,
+                 worker_index: Optional[int] = None):
         self.cfg = cfg
         self.metrics = Metrics()
-        self._forest = forest
+        self.fleet = ModelFleet(cfg, forest)
+        self.worker_index = worker_index     # multi-process front-end
         self._swap_lock = threading.Lock()   # serializes /reload only
         self.draining = False
         # admission control (degrade-don't-die): bounded in-flight ROWS
@@ -327,11 +389,16 @@ class ServingState:
         self._adm_lock = threading.Lock()
         self._inflight_rows = 0
         # circuit breaker: consecutive device-dispatch failures before
-        # the forest pins itself to the JAX-free native predictor
+        # a forest pins itself to the JAX-free native predictor.  The
+        # streak is PER FOREST (keyed by its explicit identity): one
+        # healthy fleet model's successes must not reset — or its
+        # degradation block — another model's breaker
         self.breaker_threshold = cfg.serve_breaker_threshold
         self._breaker_lock = threading.Lock()
-        self._dispatch_failures = 0
-        self.degraded = False
+        self._dispatch_failures: Dict[Tuple[str, int], int] = {}
+        # whether the streak above saw a matmul-routed failure: stage 1
+        # (disable matmul) only makes sense when matmul is implicated
+        self._streak_saw_matmul: Dict[Tuple[str, int], bool] = {}
         self.batcher = MicroBatcher(
             self._run_batch, cfg.serve_max_batch_rows,
             cfg.serve_batch_timeout_ms,
@@ -339,7 +406,21 @@ class ServingState:
 
     @property
     def forest(self) -> ServingForest:
-        return self._forest
+        """The DEFAULT model's warm forest (single-model callers)."""
+        return self.fleet.default()
+
+    @property
+    def degraded(self) -> bool:
+        """Breaker state DERIVED from the live pool: degraded while any
+        currently-pooled forest is host-pinned.  Replacing the degraded
+        instance (reload of ITS path) clears it; reloading an unrelated
+        fleet model does not falsely report recovery."""
+        return any(f.degraded for f in self.fleet.warm_models())
+
+    def forest_for(self, model: Optional[str]) -> ServingForest:
+        """Fleet routing: /predict?model=<path> -> that registered
+        model's warm forest (loaded + warmed on first use)."""
+        return self.fleet.get(model)
 
     @property
     def inflight_rows(self) -> int:
@@ -367,42 +448,80 @@ class ServingState:
     # -- circuit breaker ------------------------------------------------
     def _guarded_predict(self, forest: ServingForest, batch: Any,
                          mode: str) -> Any:
-        """Device predict with degrade-don't-die semantics: a failed
-        device dispatch answers THIS batch on the JAX-free host path
-        (byte-identical — tests pin engine parity), and after
-        `breaker_threshold` consecutive failures the breaker pins the
-        forest to the host engine until /reload."""
+        """Device predict with degrade-don't-die semantics, in ORDER
+        matmul -> descent -> native: a failed matmul dispatch answers
+        THIS batch on the descent route (still the device, whose bucket
+        warm() pre-compiled), a failed descent answers on the JAX-free
+        host path — byte-identical all three ways (tests pin route and
+        engine parity).  After `breaker_threshold` consecutive failures
+        the breaker degrades one stage: first it pins the forest to the
+        descent route (disable_matmul), then to the host engine, until
+        /reload builds a fresh forest."""
         if forest.engine != "jax":
             return forest.predict(batch, mode)
+        routed_mm = forest.matmul_routed(batch.shape[0])
         try:
             res = forest.predict(batch, mode)
         except log.LightGBMError:
             raise              # data error: the client's fault, not the device's
         except Exception as ex:
-            self._dispatch_failure(forest, ex)
+            self._dispatch_failure(forest, ex, routed_mm=routed_mm)
+            if routed_mm:
+                # stage-1 fallback: the descent executable for this
+                # bucket exists (warm compiled both routes), so answer
+                # on the device before giving up on it entirely
+                try:
+                    return forest.predict(batch, mode, route="descent")
+                except log.LightGBMError:
+                    raise
+                except Exception as ex2:
+                    self._dispatch_failure(forest, ex2)
             return forest.predict(batch, mode, engine="host")
         with self._breaker_lock:
-            if forest is self._forest:
-                self._dispatch_failures = 0
+            self._dispatch_failures.pop(forest.identity, None)
+            self._streak_saw_matmul.pop(forest.identity, None)
         return res
 
     def _dispatch_failure(self, forest: ServingForest,
-                          ex: BaseException) -> None:
+                          ex: BaseException,
+                          routed_mm: bool = False) -> None:
+        """Count one device-dispatch failure against THIS forest's
+        streak; `routed_mm` says which route the failed dispatch took.
+        Stage 1 (disable matmul) only fires when the streak implicates
+        the matmul route — a pure descent-failure streak (e.g. all
+        traffic below serve_matmul_min_rows) goes straight to the host
+        pin instead of wasting a threshold window turning off a route
+        that never ran."""
         self.metrics.dispatch_failed()
         with self._breaker_lock:
-            # in-flight batches stay pinned to a pre-/reload forest by
-            # design: its failures must not count against (or trip) the
-            # breaker on the fresh live forest
-            if forest is not self._forest:
+            # in-flight batches stay pinned to a pre-/reload (or
+            # evicted) forest by design: their failures must not count
+            # against the breaker on the live pool
+            if not self.fleet.contains(forest):
                 n, trip = 0, False
             else:
-                self._dispatch_failures += 1
-                n = self._dispatch_failures
-                trip = n >= self.breaker_threshold and not self.degraded
-                if trip:
-                    self.degraded = True
+                key = forest.identity
+                n = self._dispatch_failures.get(key, 0) + 1
+                self._dispatch_failures[key] = n
+                saw_mm = self._streak_saw_matmul.get(key, False) \
+                    or routed_mm
+                self._streak_saw_matmul[key] = saw_mm
+                trip = n >= self.breaker_threshold \
+                    and not forest.degraded
+                if trip and saw_mm and forest.matmul_live():
+                    # stage 1: matmul -> descent, this forest's counter
+                    # restarts; a further streak takes the final stage
+                    self._dispatch_failures[key] = 0
+                    self._streak_saw_matmul[key] = False
+                    forest.disable_matmul()
+                    log.warning(
+                        "serve: circuit breaker stage 1 after %d "
+                        "consecutive device-dispatch failures — matmul "
+                        "route disabled, serving on the stacked "
+                        "descent" % n)
+                    trip = False
         log.warning("serve: device dispatch failed (%s: %s); answered "
-                    "on the native fallback" % (type(ex).__name__, ex))
+                    "on the fallback path" % (type(ex).__name__, ex))
         if trip:
             forest.degrade()
             log.warning("serve: circuit breaker OPEN after %d "
@@ -460,33 +579,53 @@ class ServingState:
         return _split_lines(blob, counts)
 
     # -- hot swap -------------------------------------------------------
-    def reload(self, model_path: str) -> Dict[str, Any]:
-        """Parse + warm the new model OFF TO THE SIDE, then swap the
-        reference atomically: ANY failure in here (unreadable path,
-        parse error, warm-up crash — the reload.parse faultpoint
+    def reload(self, model_path: str,
+               make_default: bool = True) -> Dict[str, Any]:
+        """Parse + warm the new model OFF TO THE SIDE, then swap it
+        into the fleet atomically: ANY failure in here (unreadable
+        path, parse error, warm-up crash — the reload.parse faultpoint
         simulates them) propagates BEFORE the swap, so the old forest
-        keeps serving untouched."""
+        keeps serving untouched.  make_default repoints the default
+        model at the new path (the single-model /reload semantics);
+        make_default=False is the fleet's per-model in-place reload
+        (/reload?model=<path>), leaving the default alone."""
         with self._swap_lock:
-            faultpoint("reload.parse")
-            fresh = load_forest(model_path,
-                                num_model_predict=self.cfg.num_model_predict,
-                                backend=self.cfg.serve_backend)
-            fresh.warm(self.cfg.serve_max_batch_rows)
-            old = self._forest
-            self._forest = fresh   # atomic reference swap; in-flight
-            #                        batches keep keying on `old`
+            old = self.fleet.default()
+            was_degraded = self.degraded
+
+            def loader(path: str) -> ServingForest:
+                faultpoint("reload.parse")
+                fresh = load_forest(
+                    path,
+                    num_model_predict=self.cfg.num_model_predict,
+                    backend=self.cfg.serve_backend,
+                    matmul=self.cfg.serve_matmul,
+                    matmul_min_rows=self.cfg.serve_matmul_min_rows)
+                fresh.warm(self.cfg.serve_max_batch_rows)
+                return fresh
+
+            fresh = self.fleet.reload(model_path,
+                                      make_default=make_default,
+                                      loader=loader)
+            # in-flight batches keep keying on the old instance.  The
+            # degraded flag is DERIVED from the pool, so swapping a
+            # degraded instance out is what closes its breaker; prune
+            # failure streaks for forests no longer pooled
             with self._breaker_lock:
-                # a fresh forest gets a fresh device engine: close the
-                # breaker so degraded mode ends at the swap
-                self._dispatch_failures = 0
-                was_degraded = self.degraded
-                self.degraded = False
-            if was_degraded:
+                live = {f.identity for f in self.fleet.warm_models()}
+                self._dispatch_failures = {
+                    k: v for k, v in self._dispatch_failures.items()
+                    if k in live}
+                self._streak_saw_matmul = {
+                    k: v for k, v in self._streak_saw_matmul.items()
+                    if k in live}
+            if was_degraded and not self.degraded:
                 log.info("serve: circuit breaker closed by /reload")
             self.metrics.reloaded()
-            log.info("Hot-swapped model %s (%d trees) -> %s (%d trees)"
+            log.info("Hot-swapped model %s (%d trees) -> %s (%d trees)%s"
                      % (old.source, old.num_models, fresh.source,
-                        fresh.num_models))
+                        fresh.num_models,
+                        "" if make_default else " [fleet entry]"))
             return fresh.info()
 
 
@@ -588,14 +727,21 @@ def _make_handler(state: ServingState) -> type:
                            "degraded": state.degraded,
                            "uptime_s": round(
                                time.time() - state.metrics.started_at, 3),
-                           "model": state.forest.info()}
+                           "model": state.forest.info(),
+                           "models": state.fleet.info()}
+                    if state.worker_index is not None:
+                        doc["worker"] = {"index": state.worker_index,
+                                         "pid": os.getpid()}
                     self._respond(200, json.dumps(doc).encode(),
                                   "application/json")
                 elif path == "/metrics":
+                    worker = (None if state.worker_index is None
+                              else (state.worker_index, os.getpid()))
                     self._respond(
                         200, state.metrics.render(
                             state.forest, degraded=state.degraded,
-                            inflight_rows=state.inflight_rows),
+                            inflight_rows=state.inflight_rows,
+                            models=state.fleet.info(), worker=worker),
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     code = 404
@@ -611,11 +757,12 @@ def _make_handler(state: ServingState) -> type:
             path = url.path
             state.metrics.request_started(path)
             code, rows = 200, 0
+            model: Optional[Tuple[str, str]] = None
             try:
                 if path == "/predict":
-                    code, rows = self._predict(url)
+                    code, rows, model = self._predict(url)
                 elif path == "/reload":
-                    code = self._reload()
+                    code = self._reload(url)
                 else:
                     code = 404
                     self._respond(404, b"not found\n")
@@ -633,9 +780,10 @@ def _make_handler(state: ServingState) -> type:
             finally:
                 state.metrics.request_finished(path, code,
                                                time.monotonic() - t0,
-                                               rows)
+                                               rows, model=model)
 
-        def _predict(self, url: ParseResult) -> Tuple[int, int]:
+        def _predict(self, url: ParseResult) \
+                -> Tuple[int, int, Optional[Tuple[str, str]]]:
             # read the body FIRST even on early-exit paths: an unread
             # body desyncs the next request on a keep-alive connection
             body = self._body()
@@ -645,14 +793,23 @@ def _make_handler(state: ServingState) -> type:
                 self._respond(503, _error_json(
                     RuntimeError("draining")), "application/json",
                     headers=retry_hdr)
-                return 503, 0
+                return 503, 0, None
             q = parse_qs(url.query)
             mode = q.get("mode", ["normal"])[0].lower()
             if mode not in MODES:
                 raise BadRequest("unknown mode %r (expect normal|raw|"
                                  "leaf)" % mode)
             ctype = (self.headers.get("Content-Type") or "").lower()
-            forest = state.forest   # pin ONE forest for this request
+            try:
+                # fleet routing: ?model=<registered path> — then pin
+                # that ONE forest instance for the whole request
+                forest = state.forest_for(q.get("model", [None])[0])
+            except UnknownModelError as ex:
+                raise BadRequest(
+                    "unknown model %s (registered: %s)"
+                    % (ex.args[0],
+                       ", ".join(state.fleet.registered_paths())))
+            mlabel = (forest.source, forest.content_sha[:12])
             is_json = "json" in ctype
             if not is_json:
                 has_header = _qbool(q, "header", state.cfg.has_header)
@@ -673,7 +830,7 @@ def _make_handler(state: ServingState) -> type:
                     "retry later" % (state.inflight_rows,
                                      state.max_inflight_rows))),
                     "application/json", headers=retry_hdr)
-                return 503, 0
+                return 503, 0, mlabel
             try:
                 if is_json:
                     payload = RowsPayload(_parse_json_rows(body))
@@ -699,29 +856,41 @@ def _make_handler(state: ServingState) -> type:
                 self._respond(503, _error_json(
                     RuntimeError("draining")), "application/json",
                     headers=retry_hdr)
-                return 503, 0
+                return 503, 0, mlabel
             except log.LightGBMError as ex:
                 raise BadRequest(str(ex))
             finally:
                 state.release(admitted)
             self._respond(200, b"".join(parts))
-            return 200, nrows
+            return 200, nrows, mlabel
 
-        def _reload(self) -> int:
+        def _reload(self, url: ParseResult) -> int:
             body = self._body()
-            path = state.cfg.input_model
+            q = parse_qs(url.query)
+            # /reload?model=<path> is the fleet's PER-MODEL in-place
+            # reload: an ALREADY-REGISTERED entry re-parses + re-warms,
+            # the default model stays put (unregistered paths 400).  A
+            # body {"model": path} without the query keeps the
+            # single-model semantics: swap the default (the one way a
+            # new path enters the registry over HTTP).
+            in_place = q.get("model", [None])[0]
+            path = in_place or state.cfg.input_model
             if body.strip():
                 try:
                     doc = json.loads(body.decode("utf-8"))
                 except (ValueError, UnicodeDecodeError) as ex:
                     raise BadRequest("invalid JSON body: %s" % ex)
                 if isinstance(doc, dict) and doc.get("model"):
+                    if in_place:
+                        raise BadRequest(
+                            "give the model either as ?model= or in "
+                            "the body, not both")
                     path = str(doc["model"])
             if not path:
                 raise BadRequest("no model path: configure input_model "
                                  'or POST {"model": "<path>"}')
             try:
-                info = state.reload(path)
+                info = state.reload(path, make_default=not in_place)
             except Exception as ex:
                 # ANY reload failure leaves the old forest serving
                 # (the swap happens last inside state.reload); report
@@ -729,7 +898,8 @@ def _make_handler(state: ServingState) -> type:
                 # model) as 4xx, everything else as 5xx — and count it
                 state.metrics.reload_failed()
                 code = (400 if isinstance(
-                    ex, (OSError, log.LightGBMError, BadRequest))
+                    ex, (OSError, log.LightGBMError, BadRequest,
+                         UnknownModelError))
                     else 500)
                 log.warning("serve: reload failed (%s: %s); old model "
                             "kept serving" % (type(ex).__name__, ex))
@@ -755,26 +925,55 @@ class _HTTPServer(ThreadingHTTPServer):
     # test reproduced it); a deeper listen queue absorbs the burst
     request_queue_size = 128
 
+    def __init__(self, addr: Tuple[str, int], handler: type,
+                 reuse_port: bool = False):
+        self._reuse_port = reuse_port
+        super().__init__(addr, handler)
+
+    def server_bind(self) -> None:
+        if self._reuse_port:
+            # multi-process front-end (serving/frontend.py): N worker
+            # processes bind the SAME port and the kernel load-balances
+            # accepted connections across them — the flag must be set
+            # BEFORE bind, on every socket sharing the port
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
 
 class ServingServer:
     """Constructed server, not yet draining — tests/bench drive this
     directly; the CLI wraps it in serve_forever()."""
 
-    def __init__(self, cfg: Config, forest: Optional[ServingForest] = None):
+    def __init__(self, cfg: Config, forest: Optional[ServingForest] = None,
+                 reuse_port: bool = False,
+                 worker_index: Optional[int] = None):
         if forest is None:
             if not cfg.input_model:
                 log.fatal("Need a model file for serving (input_model)")
             forest = load_forest(cfg.input_model,
                                  num_model_predict=cfg.num_model_predict,
-                                 backend=cfg.serve_backend)
+                                 backend=cfg.serve_backend,
+                                 matmul=cfg.serve_matmul,
+                                 matmul_min_rows=cfg.serve_matmul_min_rows)
         t0 = time.time()
         n_buckets = forest.warm(cfg.serve_max_batch_rows)
-        log.info("Warmed %s serving forest (%d trees, %d row buckets) "
-                 "in %.3f s" % (forest.engine, forest.num_models,
-                                n_buckets, time.time() - t0))
-        self.state = ServingState(cfg, forest)
+        log.info("Warmed %s serving forest (%d trees, %d bucket "
+                 "executables) in %.3f s"
+                 % (forest.engine, forest.num_models, n_buckets,
+                    time.time() - t0))
+        self.state = ServingState(cfg, forest, worker_index=worker_index)
+        # fleet preload: every serve_models path registers; the ones
+        # that fit the warm pool parse + warm NOW so the first
+        # /predict?model= request pays no cold start
+        for path in self.state.fleet.registered_paths():
+            if path != forest.source \
+                    and len(self.state.fleet.warm_models()) \
+                    < cfg.serve_fleet_max_models:
+                self.state.fleet.get(path)
         self.httpd = _HTTPServer((cfg.serve_host, cfg.serve_port),
-                                 _make_handler(self.state))
+                                 _make_handler(self.state),
+                                 reuse_port=reuse_port)
         self.httpd.daemon_threads = True
         self._lifecycle_lock = threading.Lock()
         self._serve_started = False
@@ -824,14 +1023,10 @@ class ServingServer:
             time.sleep(0.01)
 
 
-def serve_forever(cfg: Config) -> None:
-    """CLI entry (task=serve): run until SIGTERM/SIGINT, then drain."""
-    server = ServingServer(cfg)
-    host, port = server.address
-    log.info("Serving %s on http://%s:%d (max_batch_rows=%d, "
-             "batch_timeout_ms=%g)"
-             % (server.state.forest.source, host, port,
-                cfg.serve_max_batch_rows, cfg.serve_batch_timeout_ms))
+def run_until_signal(server: ServingServer) -> None:
+    """Run a constructed server until SIGTERM/SIGINT, then drain —
+    shared by the single-process CLI entry and every front-end worker
+    process (serving/frontend.py)."""
     stop = threading.Event()
 
     def _on_signal(signum: int, frame: Any) -> None:
@@ -851,3 +1046,15 @@ def serve_forever(cfg: Config) -> None:
         server.shutdown()
         t.join(10)
         log.info("Serve drained, exiting")
+
+
+def serve_forever(cfg: Config) -> None:
+    """CLI entry (task=serve, single process): run until SIGTERM/
+    SIGINT, then drain."""
+    server = ServingServer(cfg)
+    host, port = server.address
+    log.info("Serving %s on http://%s:%d (max_batch_rows=%d, "
+             "batch_timeout_ms=%g)"
+             % (server.state.forest.source, host, port,
+                cfg.serve_max_batch_rows, cfg.serve_batch_timeout_ms))
+    run_until_signal(server)

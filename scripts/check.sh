@@ -15,6 +15,10 @@
 #      trip byte-identity, corrupt-snapshot skip, serving overload
 #      shedding, degraded-mode fallback — the fast cousin of the
 #      slow-marked tests/test_chaos.py suite
+#   4. scripts/serve_smoke.sh (when jax imports): serve round trip +
+#      reload byte parity, then the multi-process front-end leg —
+#      4 SO_REUSEPORT workers, SIGKILL-under-load respawn, per-worker
+#      liveness on /metrics
 #
 # Exit codes:
 #   0  everything that ran is clean
@@ -57,8 +61,12 @@ if python -c "import jax" 2>/dev/null; then
     bash scripts/chaos_smoke.sh
     c=$?
     [ "$c" -ne 0 ] && rc=1
+    echo "== serve smoke (round trip + reload + multi-process front-end) =="
+    bash scripts/serve_smoke.sh
+    s=$?
+    [ "$s" -ne 0 ] && rc=1
 else
-    echo "== jax not importable — chaos_smoke SKIPPED (jax-free lane) =="
+    echo "== jax not importable — chaos_smoke + serve_smoke SKIPPED (jax-free lane) =="
 fi
 
 if [ "$rc" -eq 0 ]; then

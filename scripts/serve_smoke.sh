@@ -3,6 +3,11 @@
 # start the server, round-trip one predict (bytes must equal
 # task=predict's), scrape /metrics, hot-swap via /reload (bytes must
 # equal task=predict under the NEW model), then SIGTERM-drain.
+# Then the multi-process leg (serving/frontend.py): start 4
+# SO_REUSEPORT workers, byte-compare responses vs task=predict,
+# SIGKILL one worker UNDER LOAD and assert the fleet keeps answering
+# + the supervisor respawns the slot, scrape per-worker liveness from
+# /metrics, then SIGTERM-drain the whole front-end.
 # Exits nonzero on any mismatch.  Stdlib-only clients (no curl).
 #
 # Usage: scripts/serve_smoke.sh        (from the repo root or anywhere)
@@ -19,8 +24,16 @@ export LGBM_TPU_NO_COMPILE_CACHE="${LGBM_TPU_NO_COMPILE_CACHE:-1}"
 
 work="$(mktemp -d)"
 server_pid=""
+fe_pid=""
 cleanup() {
     [ -n "$server_pid" ] && kill -9 "$server_pid" 2>/dev/null
+    if [ -n "$fe_pid" ]; then
+        # the front-end supervisor fans SIGTERM out to its workers;
+        # give it a moment, then hard-kill the process group
+        kill -TERM "$fe_pid" 2>/dev/null
+        sleep 1
+        kill -9 "$fe_pid" 2>/dev/null
+    fi
     rm -rf "$work"
 }
 trap cleanup EXIT
@@ -175,5 +188,132 @@ wait "$server_pid"
 rc=$?
 server_pid=""
 [ "$rc" -eq 0 ] || die "server exited nonzero on SIGTERM drain (rc=$rc)"
+
+# -- multi-process front-end leg (serving/frontend.py) -----------------
+fe_port="$("$PY" -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')"
+"$PY" -m lightgbm_tpu task=serve "input_model=$work/model_a.txt" \
+    "serve_port=$fe_port" serve_workers=4 serve_batch_timeout_ms=1 \
+    > "$work/frontend.log" 2>&1 &
+fe_pid=$!
+
+"$PY" - "$fe_port" <<'EOF' || { cat "$work/frontend.log" >&2; die "front-end did not come up"; }
+import sys, time, urllib.request
+port = sys.argv[1]
+deadline = time.time() + 180
+while time.time() < deadline:
+    try:
+        urllib.request.urlopen("http://127.0.0.1:%s/healthz" % port,
+                               timeout=2).read()
+        sys.exit(0)
+    except OSError:
+        time.sleep(0.2)
+sys.exit(1)
+EOF
+
+"$PY" - "$fe_port" "$work" <<'EOF' || { tail -40 "$work/frontend.log" >&2; exit 1; }
+import json, os, signal, sys, threading, time, urllib.request
+port, work = sys.argv[1], sys.argv[2]
+base = "http://127.0.0.1:%s" % port
+
+def fail(msg):
+    sys.stderr.write("serve_smoke: FAIL(frontend): %s\n" % msg)
+    sys.exit(1)
+
+def post_predict(body, timeout=60):
+    req = urllib.request.Request(base + "/predict", data=body,
+                                 headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+body = open(work + "/data.tsv", "rb").read()
+want = open(work + "/want_a.txt", "rb").read()
+
+# every connection may land on a different worker (SO_REUSEPORT picks
+# per connection): bytes must match task=predict on all of them
+for _ in range(8):
+    if post_predict(body) != want:
+        fail("front-end bytes differ from task=predict")
+
+# discover the worker pids through repeated /healthz scrapes (each
+# scrape is a fresh connection, so the kernel rotates us around the
+# fleet) — all 4 should answer eventually
+def scrape_pids(need, deadline_s=60):
+    pids, deadline = {}, time.time() + deadline_s
+    while len(pids) < need and time.time() < deadline:
+        doc = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        w = doc.get("worker")
+        if not w:
+            fail("healthz has no worker identity: %r" % doc)
+        pids[int(w["pid"])] = int(w["index"])
+    return pids
+
+pids = scrape_pids(4)
+if len(pids) < 2:
+    fail("only saw %d distinct worker pids via /healthz" % len(pids))
+
+# per-worker liveness on /metrics
+metrics = urllib.request.urlopen(base + "/metrics", timeout=30).read().decode()
+if 'lgbm_serve_worker{index="' not in metrics:
+    fail("metrics scrape missing lgbm_serve_worker liveness series")
+
+# SIGKILL one worker UNDER LOAD: the fleet must keep answering
+# byte-identically (only the victim's own connections may error) and
+# the supervisor must respawn the slot
+stop = threading.Event()
+errors = []
+def hammer():
+    while not stop.is_set():
+        try:
+            if post_predict(body, timeout=30) != want:
+                errors.append("bytes diverged under kill load")
+                return
+        except OSError:
+            pass   # the killed worker's own connection: allowed
+ts = [threading.Thread(target=hammer) for _ in range(4)]
+for t in ts:
+    t.start()
+victim = sorted(pids)[0]
+time.sleep(0.3)
+os.kill(victim, signal.SIGKILL)
+time.sleep(1.0)
+stop.set()
+for t in ts:
+    t.join()
+if errors:
+    fail(errors[0])
+# fleet still answers, and a NEW pid appears (the respawned slot)
+if post_predict(body) != want:
+    fail("front-end bytes differ after worker SIGKILL")
+deadline = time.time() + 120
+respawned = False
+while time.time() < deadline:
+    seen = scrape_pids(4, deadline_s=10)
+    if victim in seen:
+        seen.pop(victim)   # stale scrape raced the kill
+    if any(p not in pids for p in seen):
+        respawned = True
+        break
+    time.sleep(0.5)
+if not respawned:
+    fail("no respawned worker pid appeared within 120s of SIGKILL")
+print("serve_smoke: front-end predict + kill-respawn + liveness OK")
+EOF
+rc=$?
+[ "$rc" -eq 0 ] || die "front-end leg (rc=$rc)"
+
+# -- front-end graceful drain ------------------------------------------
+kill -TERM "$fe_pid"
+for _ in $(seq 1 300); do
+    kill -0 "$fe_pid" 2>/dev/null || break
+    sleep 0.1
+done
+if kill -0 "$fe_pid" 2>/dev/null; then
+    die "front-end did not drain within 30s of SIGTERM"
+fi
+wait "$fe_pid"
+rc=$?
+fe_pid=""
+[ "$rc" -eq 0 ] || die "front-end exited nonzero on SIGTERM drain (rc=$rc)"
 
 echo "serve_smoke: PASS"

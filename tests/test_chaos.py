@@ -475,6 +475,21 @@ def test_every_faultpoint_reachable(tmp_path):
         state.reload(model_path)
     finally:
         state.batcher.shutdown()
+    # frontend.spawn: the multi-process front-end's worker (re)spawn —
+    # the real seam is Frontend._spawn; two real subprocess workers
+    # come up (native backend keeps them jax-free and fast) and drain
+    from lightgbm_tpu.serving.frontend import Frontend
+    fe_cfg = Config.from_params({"task": "serve",
+                                 "input_model": model_path,
+                                 "serve_port": "0",
+                                 "serve_workers": "2",
+                                 "serve_backend": "native"})
+    fe = Frontend(fe_cfg)
+    fe.start()
+    try:
+        assert len(fe.worker_pids()) == 2
+    finally:
+        fe.shutdown(drain_timeout=20.0)
 
     missing = [n for n in faults.KNOWN_FAULTPOINTS
                if faults.hits(n) == 0]

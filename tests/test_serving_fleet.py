@@ -1,0 +1,363 @@
+"""Multi-model fleet: LRU warm pool, /predict?model= routing, per-model
+/reload, explicit batcher identity, per-model metrics labels.
+
+The batcher-identity half is the load-bearing invariant: batches key on
+the ServingForest, whose __eq__/__hash__ compare (content sha, instance
+number) — so a reload mid-flight, or two loads of byte-identical model
+text, can never coalesce rows into one dispatch.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.serving.batcher import MicroBatcher, RowsPayload
+from lightgbm_tpu.serving.fleet import ModelFleet, UnknownModelError
+from lightgbm_tpu.serving.forest import ServingForest
+from lightgbm_tpu.serving.server import ServingServer, ServingState
+
+from test_predict_fast import BINARY_MODEL
+
+MODEL_B = BINARY_MODEL.replace("leaf_value=0.2 -0.13 0.34",
+                               "leaf_value=0.9 -0.7 0.55")
+MODEL_C = BINARY_MODEL.replace("leaf_value=0.2 -0.13 0.34",
+                               "leaf_value=0.4 -0.2 0.1")
+
+
+def _write_models(tmp_path):
+    paths = {}
+    for name, text in (("a", BINARY_MODEL), ("b", MODEL_B),
+                       ("c", MODEL_C)):
+        p = tmp_path / ("model_%s.txt" % name)
+        p.write_text(text)
+        paths[name] = str(p)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# explicit forest identity
+# ---------------------------------------------------------------------------
+
+def test_forest_identity_explicit_and_unique():
+    f1 = ServingForest(BINARY_MODEL, backend="native")
+    f2 = ServingForest(BINARY_MODEL, backend="native")
+    f3 = ServingForest(MODEL_B, backend="native")
+    # same bytes -> same sha; different LOADS -> different identity
+    assert f1.content_sha == f2.content_sha
+    assert f1.identity != f2.identity
+    assert f1 != f2 and hash(f1) != hash(f2)
+    assert f1.content_sha != f3.content_sha
+    assert f1 == f1
+
+
+def test_batcher_never_coalesces_across_forest_identities():
+    """Two byte-identical models loaded separately (the reload-mid-
+    flight shape): their submissions must dispatch separately even when
+    both are queued in one batching window."""
+    f1 = ServingForest(BINARY_MODEL, backend="native")
+    f2 = ServingForest(BINARY_MODEL, backend="native")
+    dispatched = []
+
+    def run_batch(key, payloads):
+        dispatched.append((key[0], len(payloads)))
+        return [p.feats.shape[0] for p in payloads]
+
+    mb = MicroBatcher(run_batch, max_batch_rows=64,
+                      batch_timeout_ms=50.0)
+    try:
+        results = []
+        ts = [threading.Thread(
+            target=lambda f=f: results.append(
+                mb.submit((f, "raw", ("rows",)),
+                          RowsPayload(np.zeros((3, 4))))))
+            for f in (f1, f2, f1, f2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+    finally:
+        mb.shutdown()
+    assert len(results) == 4
+    # every dispatch carried exactly one forest; both forests dispatched
+    by_forest = {}
+    for forest, n_items in dispatched:
+        by_forest.setdefault(forest.identity, 0)
+        by_forest[forest.identity] += n_items
+    assert set(by_forest) == {f1.identity, f2.identity}
+    assert by_forest[f1.identity] == by_forest[f2.identity] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet pool semantics
+# ---------------------------------------------------------------------------
+
+def _fleet(tmp_path, max_models=2, serve_models=()):
+    paths = _write_models(tmp_path)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths["a"],
+        "serve_backend": "native",
+        "serve_fleet_max_models": str(max_models),
+        **({"serve_models": ",".join(serve_models)} if serve_models
+           else {})})
+    default = ServingForest(BINARY_MODEL, backend="native",
+                            source=paths["a"])
+    return paths, ModelFleet(cfg, default)
+
+
+def test_fleet_lru_eviction_and_rewarm(tmp_path):
+    paths, fleet = _fleet(tmp_path, max_models=2)
+    fleet.register(paths["b"])
+    fleet.register(paths["c"])
+    fb = fleet.get(paths["b"])          # pool: a, b
+    assert len(fleet.warm_models()) == 2
+    fc = fleet.get(paths["c"])          # b evicts (a is pinned default)
+    warm = fleet.warm_models()
+    assert len(warm) == 2 and fc in warm and fb not in warm
+    # evicted stays registered: re-get warms a FRESH instance
+    fb2 = fleet.get(paths["b"])
+    assert fb2.content_sha == fb.content_sha
+    assert fb2.identity != fb.identity
+    # default never evicts
+    assert any(f.source == paths["a"] for f in fleet.warm_models())
+
+
+def test_fleet_unregistered_model_rejected(tmp_path):
+    _, fleet = _fleet(tmp_path)
+    with pytest.raises(UnknownModelError):
+        fleet.get("/no/such/model.txt")
+
+
+def test_fleet_reload_in_place_keeps_default(tmp_path):
+    paths, fleet = _fleet(tmp_path)
+    fleet.register(paths["b"])
+    old_b = fleet.get(paths["b"])
+    fresh = fleet.reload(paths["b"], make_default=False)
+    assert fresh.identity != old_b.identity
+    assert fleet.default_path == paths["a"]
+    assert fleet.get(paths["b"]) is fresh
+
+
+# ---------------------------------------------------------------------------
+# HTTP routing + metrics labels
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fleet_server(tmp_path):
+    paths = _write_models(tmp_path)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths["a"],
+        "serve_models": paths["b"], "serve_port": "0",
+        "serve_backend": "native", "serve_batch_timeout_ms": "1",
+        "serve_fleet_max_models": "3"})
+    server = ServingServer(cfg)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield paths, server
+    finally:
+        server.shutdown()
+        t.join(10)
+
+
+def _post(url, path, data, ctype="text/plain"):
+    req = urllib.request.Request(url + path, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read()
+
+
+BODY = b"0\t1.5\t-0.25\t0.75\t2.0\n0\t-1\t0\t0.3\t0.1\n"
+
+
+def test_predict_model_param_routes(fleet_server):
+    paths, srv = fleet_server
+    _, got_def = _post(srv.url, "/predict", BODY)
+    _, got_a = _post(srv.url, "/predict?model=" + paths["a"], BODY)
+    _, got_b = _post(srv.url, "/predict?model=" + paths["b"], BODY)
+    assert got_def == got_a
+    assert got_b != got_a          # different leaf values, same rows
+    # serve_models entries preloaded warm at startup
+    h = json.loads(urllib.request.urlopen(srv.url + "/healthz",
+                                          timeout=10).read())
+    warm = {m["source"]: m for m in h["models"]}
+    assert warm[paths["a"]]["warm"] and warm[paths["a"]]["default"]
+    assert warm[paths["b"]]["warm"] and not warm[paths["b"]]["default"]
+    assert all("sha" in m for m in h["models"])
+
+
+def test_predict_unknown_model_structured_400(fleet_server):
+    paths, srv = fleet_server
+    try:
+        _post(srv.url, "/predict?model=/nope.txt", BODY)
+        assert False, "unknown model did not error"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        doc = json.loads(e.read())
+        assert "unknown model" in doc["message"]
+        assert paths["a"] in doc["message"]
+
+
+def test_per_model_metrics_labels(fleet_server):
+    paths, srv = fleet_server
+    _post(srv.url, "/predict", BODY)
+    _post(srv.url, "/predict?model=" + paths["b"], BODY)
+    _post(srv.url, "/predict?model=" + paths["b"], BODY)
+    m = urllib.request.urlopen(srv.url + "/metrics",
+                               timeout=10).read().decode()
+    fa = srv.state.fleet.get(paths["a"])
+    fb = srv.state.fleet.get(paths["b"])
+    assert ('lgbm_serve_model_requests_total{model="%s",sha="%s"} 1'
+            % (paths["a"], fa.content_sha[:12])) in m
+    assert ('lgbm_serve_model_requests_total{model="%s",sha="%s"} 2'
+            % (paths["b"], fb.content_sha[:12])) in m
+    assert ('lgbm_serve_model_rows_total{model="%s",sha="%s"} 4'
+            % (paths["b"], fb.content_sha[:12])) in m
+    # fleet identity gauges: one labeled series per warm model
+    for p, f in ((paths["a"], fa), (paths["b"], fb)):
+        assert ('lgbm_serve_fleet_model_loaded_timestamp_seconds'
+                '{model="%s",sha="%s"' % (p, f.content_sha[:12])) in m
+    # the unlabeled default-model gauge keeps its historical name
+    assert "\nlgbm_serve_model_loaded_timestamp_seconds " in m
+
+
+def test_reload_query_param_in_place(fleet_server):
+    paths, srv = fleet_server
+    _, got_b = _post(srv.url, "/predict?model=" + paths["b"], BODY)
+    old_b = srv.state.fleet.get(paths["b"])
+    st, raw = _post(srv.url, "/reload?model=" + paths["b"], b"")
+    assert st == 200
+    info = json.loads(raw)
+    assert info["source"] == paths["b"]
+    # fresh instance, same bytes served; default untouched
+    new_b = srv.state.fleet.get(paths["b"])
+    assert new_b.identity != old_b.identity
+    assert srv.state.fleet.default_path == paths["a"]
+    assert _post(srv.url, "/predict?model=" + paths["b"], BODY)[1] \
+        == got_b
+    # in-place reload of an UNREGISTERED path is a 400, not a silent
+    # allow-list expansion (a typo'd /reload?model= must not create a
+    # phantom registered model); explicit register() then serves it
+    try:
+        _post(srv.url, "/reload?model=" + paths["c"], b"")
+        assert False, "unregistered in-place reload did not error"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert paths["c"] not in srv.state.fleet.registered_paths()
+    srv.state.fleet.register(paths["c"])
+    st, _ = _post(srv.url, "/reload?model=" + paths["c"], b"")
+    assert st == 200
+    _, got_c = _post(srv.url, "/predict?model=" + paths["c"], BODY)
+    assert got_c != got_b
+    assert srv.state.fleet.default_path == paths["a"]
+    # body + query together is ambiguous -> 400
+    try:
+        _post(srv.url, "/reload?model=" + paths["b"],
+              json.dumps({"model": paths["c"]}).encode(),
+              "application/json")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_reload_body_swaps_default(fleet_server):
+    paths, srv = fleet_server
+    _, got_b = _post(srv.url, "/predict?model=" + paths["b"], BODY)
+    st, _ = _post(srv.url, "/reload",
+                  json.dumps({"model": paths["b"]}).encode(),
+                  "application/json")
+    assert st == 200
+    assert srv.state.fleet.default_path == paths["b"]
+    assert _post(srv.url, "/predict", BODY)[1] == got_b
+
+
+def test_reload_failure_keeps_fleet_serving(fleet_server):
+    paths, srv = fleet_server
+    _, want = _post(srv.url, "/predict", BODY)
+    try:
+        _post(srv.url, "/reload",
+              json.dumps({"model": str(paths["a"]) + ".missing"}).encode(),
+              "application/json")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    assert srv.state.fleet.default_path == paths["a"]
+    assert _post(srv.url, "/predict", BODY)[1] == want
+
+
+# ---------------------------------------------------------------------------
+# per-model circuit breaker
+# ---------------------------------------------------------------------------
+
+def _jax_state(tmp_path, threshold):
+    paths = _write_models(tmp_path)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths["a"],
+        "serve_backend": "jax",
+        "serve_breaker_threshold": str(threshold),
+        "serve_max_batch_rows": "32", "serve_batch_timeout_ms": "1"})
+    fa = ServingForest(BINARY_MODEL, backend="jax", source=paths["a"])
+    state = ServingState(cfg, fa)
+    state.fleet.register(paths["b"])
+    return paths, fa, state
+
+
+def test_breaker_per_model_isolation(tmp_path):
+    """Failure streaks are PER forest: model A's successes must not
+    reset model B's streak, and a degraded B must not block A's own
+    breaker from tripping later."""
+    paths, fa, state = _jax_state(tmp_path, threshold=2)
+    fb = state.fleet.get(paths["b"])
+    err = RuntimeError("device dead")
+    try:
+        x = fa.fit_width(np.random.RandomState(0).randn(8, 5))
+        state._dispatch_failure(fb, err)
+        # a SUCCESS on model A between B's failures...
+        np.testing.assert_array_equal(
+            state._guarded_predict(fa, x, "raw"),
+            fa.predict(x, "raw", engine="host"))
+        # ...must not have reset B's streak: the next failure trips it
+        state._dispatch_failure(fb, err)
+        assert fb.degraded and fb.engine == "host"
+        assert not fa.degraded and fa.engine == "jax"
+        assert state.degraded              # a pooled member is degraded
+        # and B's open breaker does not block A's from tripping
+        state._dispatch_failure(fa, err)
+        state._dispatch_failure(fa, err)
+        assert fa.degraded and fa.engine == "host"
+    finally:
+        state.batcher.shutdown()
+
+
+def test_reload_elsewhere_keeps_degraded_honest(tmp_path):
+    """The degraded flag derives from the live pool: reloading an
+    UNRELATED fleet model must not report recovery while the degraded
+    default is still host-pinned; replacing the degraded instance
+    itself is what closes the breaker."""
+    paths, fa, state = _jax_state(tmp_path, threshold=1)
+    err = RuntimeError("device dead")
+    try:
+        state._dispatch_failure(fa, err)
+        assert fa.degraded and state.degraded
+        state.reload(paths["b"], make_default=False)
+        assert state.degraded              # fa still pinned + serving
+        state.reload(paths["a"], make_default=False)
+        assert not state.degraded          # fresh default instance
+        assert state.forest.engine == "jax"
+    finally:
+        state.batcher.shutdown()
+
+
+def test_reload_of_unregistered_path_in_place_raises(tmp_path):
+    paths, fleet = _fleet(tmp_path)
+    with pytest.raises(UnknownModelError):
+        fleet.reload(paths["c"], make_default=False)
+    assert paths["c"] not in fleet.registered_paths()
+    # the default-swap form is the legitimate registration route
+    fresh = fleet.reload(paths["c"], make_default=True)
+    assert fleet.default_path == paths["c"]
+    assert fresh in fleet.warm_models()

@@ -1,0 +1,170 @@
+"""SO_REUSEPORT multi-process front-end: shared port, byte parity,
+worker death + respawn, SIGTERM fan-out drain, chaos-testable spawn.
+
+Workers run serve_backend=native (jax-free subprocesses: fast startup,
+and the parity bar is the same — the native engine is byte-identical
+to the device engines by the serving test suite).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.serving.frontend import Frontend
+
+from test_predict_fast import BINARY_MODEL
+from test_serving import cli_predict
+
+BODY = b"0\t1.5\t-0.25\t0.75\t2.0\n0\t-1\t0\t0.3\t0.1\n"
+
+
+@pytest.fixture
+def frontend(tmp_path):
+    model = tmp_path / "m.txt"
+    model.write_text(BINARY_MODEL)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": str(model), "serve_port": "0",
+        "serve_workers": "2", "serve_backend": "native",
+        "serve_batch_timeout_ms": "1"})
+    fe = Frontend(cfg)
+    fe.start()
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            fe._monitor_once(timeout=0.2)
+            fe._sweep_empty_slots()
+
+    t = threading.Thread(target=monitor, daemon=True)
+    t.start()
+    url = "http://127.0.0.1:%d" % fe.port
+    deadline = time.time() + 60
+    while True:
+        try:
+            urllib.request.urlopen(url + "/healthz", timeout=2).read()
+            break
+        except OSError:
+            assert time.time() < deadline, "front-end never came up"
+            time.sleep(0.2)
+    try:
+        yield fe, url, str(model)
+    finally:
+        stop.set()
+        t.join(10)
+        fe.shutdown(drain_timeout=20.0)
+
+
+def _post(url, data, tries=3):
+    for i in range(tries):
+        try:
+            req = urllib.request.Request(url + "/predict", data=data)
+            with urllib.request.urlopen(req, timeout=15) as r:
+                return r.read()
+        except OSError:
+            # a connection that landed on a just-killed worker resets;
+            # a retry is a NEW connection, routed to a live worker
+            if i == tries - 1:
+                raise
+            time.sleep(0.05)
+
+
+def test_frontend_bytes_match_task_predict(frontend, tmp_path):
+    _, url, model = frontend
+    data = tmp_path / "d.tsv"
+    data.write_bytes(BODY)
+    want = cli_predict(tmp_path, model, str(data), "normal")
+    assert _post(url, BODY) == want
+
+
+def test_frontend_scrapes_show_every_worker(frontend):
+    fe, url, _ = frontend
+    seen = set()
+    for _ in range(120):
+        h = json.loads(urllib.request.urlopen(url + "/healthz",
+                                              timeout=5).read())
+        seen.add((h["worker"]["index"], h["worker"]["pid"]))
+        if len(seen) >= 2:
+            break
+    assert len(seen) >= 2, \
+        "SO_REUSEPORT never routed a scrape to the second worker"
+    assert {i for i, _ in seen} == {0, 1}
+    m = urllib.request.urlopen(url + "/metrics",
+                               timeout=5).read().decode()
+    assert 'lgbm_serve_worker{index="' in m
+
+
+def test_frontend_survives_worker_sigkill(frontend):
+    fe, url, _ = frontend
+    want = _post(url, BODY)
+    victim = fe.worker_pids()[0]
+    os.kill(victim, signal.SIGKILL)
+    # the fleet keeps answering correct bytes throughout (new
+    # connections route to live workers; only the victim's own
+    # connections can reset, and _post retries those)
+    for _ in range(30):
+        assert _post(url, BODY) == want
+        time.sleep(0.01)
+    # ... and the dead slot respawns
+    deadline = time.time() + 30
+    while victim in fe.worker_pids() or len(fe.worker_pids()) < 2:
+        assert time.time() < deadline, "worker never respawned"
+        time.sleep(0.2)
+    assert _post(url, BODY) == want
+
+
+def test_frontend_spawn_faultpoint_counts():
+    """Frontend._spawn crosses the frontend.spawn seam once per worker
+    — an injected failure surfaces as a retried slot, not a crash
+    (schedule parse + reachability; the full respawn chaos leg lives
+    in serve_smoke.sh)."""
+    faults.reset()
+    try:
+        faults.configure("frontend.spawn@1=raise")
+        with pytest.raises(faults.FaultInjected):
+            faults.faultpoint("frontend.spawn")
+        assert faults.hits("frontend.spawn") == 1
+    finally:
+        faults.reset()
+
+
+def test_frontend_requires_two_workers(tmp_path):
+    model = tmp_path / "m.txt"
+    model.write_text(BINARY_MODEL)
+    cfg = Config.from_params({"task": "serve",
+                              "input_model": str(model),
+                              "serve_workers": "1"})
+    with pytest.raises(ValueError):
+        Frontend(cfg)
+
+
+def test_frontend_startup_crash_loop_gives_up(tmp_path, monkeypatch):
+    """A fleet whose workers can NEVER come up (typo'd input_model)
+    must exit with the diagnostic after STARTUP_CRASH_LIMIT strikes
+    per slot — not respawn forever at 100% host burn."""
+    from lightgbm_tpu.serving import frontend as fe_mod
+    from lightgbm_tpu.utils.log import LightGBMError
+    monkeypatch.setattr(fe_mod, "RESPAWN_BACKOFF_S", 0.05)
+    monkeypatch.setattr(fe_mod, "RESPAWN_BACKOFF_MAX_S", 0.1)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": str(tmp_path / "missing.txt"),
+        "serve_port": "0", "serve_workers": "2",
+        "serve_backend": "native"})
+    fe = Frontend(cfg)
+    fe.start()
+    try:
+        deadline = time.time() + 120
+        with pytest.raises(LightGBMError, match="crash-looped"):
+            while time.time() < deadline:
+                fe._monitor_once(timeout=0.1)
+                fe._sweep_empty_slots()
+            raise AssertionError(
+                "supervisor kept respawning a hopeless fleet")
+    finally:
+        fe.shutdown(drain_timeout=5.0)

@@ -1,0 +1,296 @@
+"""Device matmul serve routing: byte-identity vs the host descent.
+
+The serving forest routes batches of >= serve_matmul_min_rows rows
+through the gather-free matmul predictor (ops/predict.
+predict_leaf_matmul, the batch path's accelerator kernel).  The rank
+encoding is EXACT in the f64 total order, so leaf indices — and
+therefore every served byte — must be identical to the stacked descent
+and to the JAX-free host engine, across modes, request formats, the
+0-row and oversize-split edges, and the breaker's degraded stages.
+
+serve_matmul=on forces the route on this CPU-only container (auto
+engages accelerators only, mirroring the batch predictor's line).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.serving.forest import ServingForest
+from lightgbm_tpu.serving.server import ServingServer, ServingState
+
+from test_predict_fast import BINARY_MODEL, MULTI_MODEL, _rows
+
+MODES = ("normal", "raw", "leaf")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _feats(n, f=4, seed=7):
+    return np.random.RandomState(seed).randn(n, f)
+
+
+# ---------------------------------------------------------------------------
+# forest-level route parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model,f", [(BINARY_MODEL, 4), (MULTI_MODEL, 3)])
+@pytest.mark.parametrize("mode", MODES)
+def test_matmul_route_matches_descent_and_host(model, f, mode):
+    if model is MULTI_MODEL and mode == "leaf":
+        pytest.skip("leaf ids per class covered by the binary case")
+    mm = ServingForest(model, backend="jax", matmul="on",
+                       matmul_min_rows=1)
+    x = _feats(123, f)
+    got = mm.predict(x, mode)                      # auto: matmul route
+    descent = mm.predict(x, mode, route="descent")
+    host = mm.predict(x, mode, engine="host")
+    np.testing.assert_array_equal(got, descent)
+    np.testing.assert_array_equal(got, host)
+    assert mm.format_rows(got, mode) == mm.format_rows(descent, mode)
+
+
+def test_matmul_threshold_routes_by_rows():
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=32)
+    assert not forest.matmul_routed(31)
+    assert forest.matmul_routed(32)
+    # parity does not depend on which side of the threshold a batch
+    # falls (different kernels, same bytes)
+    small, big = _feats(31), _feats(32)
+    for mode in MODES:
+        np.testing.assert_array_equal(
+            forest.predict(small, mode),
+            forest.predict(small, mode, engine="host"))
+        np.testing.assert_array_equal(
+            forest.predict(big, mode),
+            forest.predict(big, mode, engine="host"))
+
+
+def test_matmul_auto_stays_off_on_cpu():
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="auto",
+                           matmul_min_rows=1)
+    assert not forest.matmul_enabled()      # CPU container: descent wins
+    forest_off = ServingForest(BINARY_MODEL, backend="jax", matmul="off")
+    assert not forest_off.matmul_routed(10_000)
+
+
+def test_matmul_zero_rows_mode_shaped():
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=1)
+    assert forest.predict(np.zeros((0, 4)), "leaf").shape \
+        == (0, forest.num_models)
+    assert forest.predict(np.zeros((0, 4)), "raw").shape == (1, 0)
+
+
+def test_matmul_disable_is_stage_one():
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=1)
+    x = _feats(40)
+    want = forest.predict(x, "raw")
+    assert forest.matmul_live()
+    forest.disable_matmul()
+    assert not forest.matmul_routed(40)
+    assert forest.engine == "jax" and not forest.degraded
+    np.testing.assert_array_equal(forest.predict(x, "raw"), want)
+
+
+# ---------------------------------------------------------------------------
+# served bytes through the full HTTP stack
+# ---------------------------------------------------------------------------
+
+def _serve(model_text, tmp_path, **params):
+    model = tmp_path / "mm_model.txt"
+    model.write_text(model_text)
+    p = {"task": "serve", "input_model": str(model), "serve_port": "0",
+         "serve_max_batch_rows": "64", "serve_batch_timeout_ms": "1",
+         "serve_matmul": "on", "serve_matmul_min_rows": "8"}
+    p.update({k: str(v) for k, v in params.items()})
+    cfg = Config.from_params(p)
+    server = ServingServer(cfg)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t
+
+
+def _post(url, path, data, ctype="text/plain"):
+    req = urllib.request.Request(url + path, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read()
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("fmt", ["csv", "json"])
+def test_served_matmul_bytes_match_native_engine(tmp_path, mode, fmt):
+    """{normal, raw, leaf} x {CSV, JSON}: the matmul-routed server's
+    bytes equal the JAX-free native engine's for the same body —
+    including an oversize request the batcher splits (70 rows >
+    serve_max_batch_rows=32 segments) and a sub-threshold one."""
+    if fmt == "csv":
+        # the shared ragged-row fixture: na tokens, short/wide rows —
+        # the text parse rules must not interact with the route
+        rows = _rows(n=70)
+        body = ("\n".join("\t".join(r) for r in rows) + "\n").encode()
+        ctype = "text/plain"
+    else:
+        feats = np.random.RandomState(5).randn(70, 4).round(6)
+        body = json.dumps({"rows": feats.tolist()}).encode()
+        ctype = "application/json"
+
+    srv_mm, t_mm = _serve(BINARY_MODEL, tmp_path,
+                          serve_max_batch_rows=32)
+    srv_nat, t_nat = _serve(BINARY_MODEL, tmp_path,
+                            serve_backend="native",
+                            serve_max_batch_rows=32)
+    try:
+        assert srv_mm.state.forest.matmul_live()
+        st, got = _post(srv_mm.url, "/predict?mode=" + mode, body, ctype)
+        st2, want = _post(srv_nat.url, "/predict?mode=" + mode, body,
+                          ctype)
+        assert st == st2 == 200
+        assert got == want, "matmul-served bytes differ (%s/%s)" \
+            % (mode, fmt)
+        # 0-row body: empty 200 either way
+        empty = b"" if fmt == "csv" else b'{"rows": []}'
+        assert _post(srv_mm.url, "/predict?mode=" + mode, empty,
+                     ctype) == (200, b"")
+    finally:
+        srv_mm.shutdown()
+        srv_nat.shutdown()
+        t_mm.join(10)
+        t_nat.join(10)
+
+
+def test_breaker_degrades_matmul_then_descent_then_native(tmp_path):
+    """The staged breaker: matmul failures first pin the descent route
+    (device still serving), a second streak pins the host engine —
+    bytes identical at every stage, each failed batch still answered."""
+    model = tmp_path / "m.txt"
+    model.write_text(BINARY_MODEL)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": str(model),
+        "serve_matmul": "on", "serve_matmul_min_rows": "1",
+        "serve_breaker_threshold": "2", "serve_max_batch_rows": "64",
+        "serve_batch_timeout_ms": "0"})
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=1)
+    forest.warm(64)
+    state = ServingState(cfg, forest)
+    x = forest.fit_width(_feats(24))
+    want = forest.predict(x, "raw", engine="host")
+    try:
+        # two matmul-routed failures -> stage 1 (matmul disabled);
+        # every failed batch is still answered byte-identically
+        faults.configure("serve.dispatch@1=raise;serve.dispatch@2=raise")
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        assert forest.matmul_disabled and forest.engine == "jax"
+        assert not state.degraded
+        # two descent failures -> final stage (host engine pinned)
+        faults.reset()
+        faults.configure("serve.dispatch@1=raise;serve.dispatch@2=raise")
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        assert state.degraded and forest.engine == "host"
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+    finally:
+        state.batcher.shutdown()
+
+
+def test_transient_matmul_blip_answers_on_descent(tmp_path):
+    """One failed matmul dispatch answers THAT batch on the descent
+    route without tripping any stage."""
+    model = tmp_path / "m.txt"
+    model.write_text(BINARY_MODEL)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": str(model),
+        "serve_matmul": "on", "serve_matmul_min_rows": "1",
+        "serve_breaker_threshold": "3"})
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=1)
+    forest.warm(64)
+    state = ServingState(cfg, forest)
+    x = forest.fit_width(_feats(16))
+    want = forest.predict(x, "raw", engine="host")
+    try:
+        faults.configure("serve.dispatch@1=raise")
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        assert not forest.matmul_disabled and not state.degraded
+        assert state.metrics.dispatch_failures_total == 1
+        # next dispatch succeeds on matmul and resets the streak
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        assert not state._dispatch_failures.get(forest.identity)
+    finally:
+        state.batcher.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# steady state: zero recompiles through the matmul route
+# ---------------------------------------------------------------------------
+
+def test_matmul_steady_state_zero_recompiles(xla_guard):
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=8)
+    forest.warm(64)
+    width = forest.max_feature_idx + 1
+    with xla_guard(0, what="matmul-routed serving steady state"):
+        for i, n in enumerate((8, 17, 33, 64, 11, 48)):
+            assert forest.matmul_routed(n)
+            for mode in MODES:
+                res = forest.predict(_feats(n, width, seed=i), mode)
+                if mode == "leaf":
+                    assert res.shape == (n, forest.num_models)
+        # the breaker's stage-1 descent fallback is pre-compiled too:
+        # degrading mid-steady-state must not compile either
+        forest.disable_matmul()
+        for mode in MODES:
+            forest.predict(_feats(33, width, seed=9), mode)
+
+
+def test_descent_streak_goes_straight_to_host(tmp_path):
+    """All traffic below serve_matmul_min_rows: the failing route is
+    the descent, so the breaker must NOT waste a threshold window
+    disabling the never-implicated matmul route before pinning host."""
+    model = tmp_path / "m.txt"
+    model.write_text(BINARY_MODEL)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": str(model),
+        "serve_matmul": "on", "serve_matmul_min_rows": "32",
+        "serve_breaker_threshold": "2", "serve_max_batch_rows": "64",
+        "serve_batch_timeout_ms": "0"})
+    forest = ServingForest(BINARY_MODEL, backend="jax", matmul="on",
+                           matmul_min_rows=32)
+    forest.warm(64)
+    assert forest.matmul_live()      # pack built: stage 1 WOULD exist
+    state = ServingState(cfg, forest)
+    x = forest.fit_width(_feats(8))  # below the matmul threshold
+    want = forest.predict(x, "raw", engine="host")
+    try:
+        faults.configure("serve.dispatch@1=raise;serve.dispatch@2=raise")
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        np.testing.assert_array_equal(
+            state._guarded_predict(forest, x, "raw"), want)
+        # straight to the host pin — matmul was never the failing route
+        assert not forest.matmul_disabled
+        assert state.degraded and forest.engine == "host"
+    finally:
+        state.batcher.shutdown()
