@@ -1053,6 +1053,97 @@ def run_dart_pair():
     return ours, ref
 
 
+# out-of-core ingest + chips-vs-throughput capture (ISSUE 10): synthetic
+# Criteo-class files, sized small enough for CI and env-tunable for the
+# honest at-scale run (BENCH_INGEST_MB=2048 for a 2 GB pass)
+INGEST_MB = int(os.environ.get("BENCH_INGEST_MB", 48))
+INGEST_TREES = int(os.environ.get("BENCH_INGEST_TREES", 6))
+INGEST_ROWS = int(os.environ.get("BENCH_INGEST_ROWS", 60_000))
+INGEST_MESHES = [int(s) for s in os.environ.get(
+    "BENCH_INGEST_SHARDS", "1,2,4,8").split(",") if s.strip()]
+
+
+def run_ingest_scale_bench():
+    """Ingestion throughput (dense + LibSVM, rows/s and MB/s through
+    the out-of-core shard writer) and the chips-vs-throughput table:
+    shard-fed tree_learner=data training at 1/2/4/8 shards-of-mesh
+    over the SAME manifest, with scaling efficiency vs the 1-shard
+    run.  On a virtual-device CPU host the shards share physical
+    cores, so efficiency there is a lower bound — the honest per-chip
+    curve needs real multi-chip hardware (BASELINE.md flags the TPU
+    recapture)."""
+    import shutil
+
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ingest.shards import load_sharded_dataset
+    from lightgbm_tpu.ingest.synth import cached_file, generate
+    from lightgbm_tpu.ingest.writer import ingest
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    out = {}
+    # every config here shares the manifest fingerprint keys (max_bin
+    # etc. at defaults) so training reuses the ingested shards as-is
+    icfg = Config.from_params({"ingest_workers": "0",
+                               "ingest_memory_budget_mb": "512",
+                               # several shards per manifest: the
+                               # training rounds must exercise the
+                               # per-shard-window device feed
+                               "ingest_shard_rows": "16384"})
+    for fmt, key in (("tsv", "dense"), ("libsvm", "libsvm")):
+        path = cached_file(CACHE, INGEST_MB << 20, fmt=fmt)
+        sd = path + ".shards"
+        shutil.rmtree(sd, ignore_errors=True)
+        t0 = time.time()
+        m = ingest([path], sd, icfg)
+        wall = time.time() - t0
+        size = os.path.getsize(path)
+        out["ingest_%s_mb_s" % key] = round(size / (1 << 20) / wall, 2)
+        out["ingest_%s_rows_s" % key] = round(m.num_rows / wall, 1)
+
+    # chips-vs-throughput over one fixed-size training manifest
+    train_src = os.path.join(CACHE, "ingest_scale_%d.tsv" % INGEST_ROWS)
+    if not os.path.isfile(train_src):
+        generate(train_src, rows=INGEST_ROWS, fmt="tsv", seed=7)
+    scale_dir = train_src + ".shards"
+    ingest([train_src], scale_dir, icfg)
+    ndev = len(jax.devices())
+    scale, eff = {}, {}
+    base = None
+    for k in INGEST_MESHES:
+        if k > ndev:
+            continue
+        cfg = Config.from_params({
+            "objective": "binary", "tree_learner": "data",
+            "num_shards": str(k), "num_leaves": "15",
+            "min_data_in_leaf": "20", "metric": "",
+            "iter_batch": ITER_BATCH, "is_save_binary_file": "false"})
+        ds = load_sharded_dataset(scale_dir, cfg)
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        booster = create_boosting(cfg, ds, obj)
+        _drive(booster, _warm_n(booster, 4, 2))
+        booster._flush_pending()
+        np.asarray(booster.scores).sum()
+        t0 = time.time()
+        _drive(booster, INGEST_TREES)
+        booster._flush_pending()
+        np.asarray(booster.scores).sum()
+        steady = time.time() - t0
+        rows_s = ds.num_data * INGEST_TREES / steady
+        scale[str(k)] = round(rows_s, 1)
+        if base is None:
+            base = (k, rows_s)
+        eff[str(k)] = round(rows_s / (base[1] * k / base[0]), 4)
+        del booster, ds, obj
+    out["ingest_scale_rows_s"] = scale
+    out["ingest_scale_efficiency"] = eff
+    out["ingest_scale_devices"] = ndev
+    return out
+
+
 def main():
     # predict e2e measures FIRST, before this process opens its own TPU
     # session — a live parent session contends with the subprocess on
@@ -1211,6 +1302,14 @@ def main():
             extras.update(run_serving_scale_bench())
         except Exception as e:
             extras["serve_scale_error"] = str(e)[:200]
+
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        # out-of-core ingest throughput (dense + LibSVM) + the shard-fed
+        # tree_learner=data chips-vs-throughput scaling table
+        try:
+            extras.update(run_ingest_scale_bench())
+        except Exception as e:
+            extras["ingest_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_PREDICT", "1") != "0":
         if predict_extras is None:

@@ -102,7 +102,7 @@ JAX_FREE_MARKER = "__jax_free__"
 #: contract one way or the other.
 DECLARE_DIRS: Tuple[str, ...] = ("serving", "io", "utils", "analysis",
                                  "native", "parallel", "models",
-                                 "resilience")
+                                 "resilience", "ingest")
 
 #: modules PINNED jax-free: these must declare `__jax_free__ = True` —
 #: deleting the marker (or flipping it to False) is a finding (GC007),
@@ -126,6 +126,10 @@ EXPECTED_JAX_FREE: Tuple[str, ...] = (
     "resilience/__init__.py", "resilience/atomic.py",
     "resilience/faults.py", "resilience/net.py",
     "resilience/snapshot.py",
+    # out-of-core ingestion: the parse/shard-write paths run in
+    # jax-free lanes (CLI task=ingest, multiprocessing parse workers)
+    "ingest/__init__.py", "ingest/manifest.py", "ingest/writer.py",
+    "ingest/shards.py", "ingest/synth.py",
 )
 
 # ---------------------------------------------------------------------------

@@ -178,6 +178,10 @@ PARITY_MODULES: Set[str] = {
     "io/parser.py", "io/binning.py", "io/dataset.py",
     "native/__init__.py", "utils/mt19937.py",
     "parallel/mesh.py", "parallel/dist.py",
+    # out-of-core ingest: shard bytes must equal the in-memory
+    # loader's bins bit-for-bit (synth.py is OUT on purpose — it
+    # generates random benchmark data, not parity artifacts)
+    "ingest/manifest.py", "ingest/writer.py", "ingest/shards.py",
 }
 PARITY_PREFIXES = ("ops/",)
 

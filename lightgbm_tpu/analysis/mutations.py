@@ -133,6 +133,19 @@ def _t_marker_off_batcher(src: str) -> str:
         what="__jax_free__ marker removal from serving/batcher.py")
 
 
+def _t_jax_into_ingest_writer(src: str) -> str:
+    return _insert_after(
+        src, "import numpy as np\n",
+        "import jax  # seeded violation\n",
+        what="module-level jax into ingest/writer.py")
+
+
+def _t_marker_off_ingest_shards(src: str) -> str:
+    return _replace_once(
+        src, "\n__jax_free__ = True\n", "\n",
+        what="__jax_free__ marker removal from ingest/shards.py")
+
+
 def _t_marker_off_dist(src: str) -> str:
     return _replace_once(
         src, "\n__jax_free__ = True\n", "\n",
@@ -285,6 +298,19 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "a lazy `import jax` inside native.get_lib — reached from the "
        "@contract.jax_free fast-predict / serving fallback closures",
        _t_lazy_jax_in_get_lib),
+
+    _m("jax-into-ingest-writer", "jax_free", "ingest/writer.py",
+       "GC002", "ingest/writer.py", "jax",
+       "module-level `import jax` in the ingest bin-pass — the "
+       "parse/shard-write path must stay importable (and fork-safe) "
+       "in jax-free lanes: CLI task=ingest, parse worker processes",
+       _t_jax_into_ingest_writer),
+    _m("marker-removed-from-ingest-shards", "jax_free",
+       "ingest/shards.py", "GC007", "ingest/shards.py",
+       "pinned jax-free",
+       "deleting the __jax_free__ declaration from a module PINNED by "
+       "EXPECTED_JAX_FREE under the new ingest/ tree",
+       _t_marker_off_ingest_shards),
 
     _m("pinned-marker-removed-from-dist", "jax_free",
        "parallel/dist.py", "GC007", "parallel/dist.py",

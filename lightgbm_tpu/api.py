@@ -130,7 +130,7 @@ class Dataset:
             refin = self._reference.inner
             ds = io_dataset.Dataset(
                 bins=np.zeros((refin.num_features, n),
-                              dtype=refin.bins.dtype),
+                              dtype=refin.bin_dtype),
                 bin_mappers=refin.bin_mappers,
                 used_feature_map=refin.used_feature_map,
                 real_feature_index=refin.real_feature_index,
@@ -209,11 +209,11 @@ class Dataset:
 
         if self._reference is not None:
             refin = self._reference.inner
-            bins = np.zeros((refin.num_features, n), dtype=refin.bins.dtype)
+            bins = np.zeros((refin.num_features, n), dtype=refin.bin_dtype)
             for inner, real in enumerate(refin.real_feature_index):
                 bins[inner] = col_bins(
                     refin.bin_mappers[inner], int(real),
-                    refin.bins.dtype, n, csc.indptr, csc.indices,
+                    refin.bin_dtype, n, csc.indptr, csc.indices,
                     csc.data)
             self._finish_inner(bins, refin.bin_mappers,
                                refin.used_feature_map,

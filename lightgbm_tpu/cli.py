@@ -59,6 +59,12 @@ class Application:
             self._apply_device_type()
             self.init_train()
             self.train()
+        elif self.config.task == "ingest":
+            # out-of-core text -> binned shard directory (ingest/):
+            # host-only preprocessing, deliberately jax-free — TB-scale
+            # ingest lanes must not pay a backend init
+            from .ingest.writer import run_ingest_cli
+            run_ingest_cli(self.config)
         elif self.config.task == "serve":
             # warm-model HTTP prediction service (serving/): jax imports
             # lazily inside the forest only when its engine is selected,

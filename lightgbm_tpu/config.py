@@ -104,7 +104,7 @@ class Config:
     dataclass is the idiomatic Python equivalent)."""
 
     # -- task / top-level ------------------------------------------------
-    task: str = "train"                   # train | predict | serve
+    task: str = "train"                   # train | predict | serve | ingest
     num_threads: int = 0
     boosting_type: str = "gbdt"           # gbdt | dart
     objective: str = "regression"         # regression | binary | multiclass | lambdarank
@@ -266,6 +266,21 @@ class Config:
     #                                       warm; registered models past
     #                                       it re-warm on demand
 
+    # -- out-of-core ingestion (ingest/) ---------------------------------
+    ingest_dir: str = ""                  # task=ingest output directory
+    #                                       ("" = <data>.shards); training
+    #                                       accepts data=<ingest_dir>
+    ingest_memory_budget_mb: int = 1024   # hard host-memory budget for
+    #                                       the chunked text->shard bin
+    #                                       pass (bounds chunk size,
+    #                                       in-flight worker results and
+    #                                       the shard assembly buffer)
+    ingest_shard_rows: int = 0            # rows per shard file (0 = auto
+    #                                       from the memory budget)
+    ingest_workers: int = 0               # parallel parse worker
+    #                                       processes (0 = auto, 1 =
+    #                                       inline single-process)
+
     # -- fault tolerance (resilience/) -----------------------------------
     snapshot_period: int = 0              # snapshot every N iterations
     #                                       (0 = off); requires
@@ -321,6 +336,8 @@ class Config:
                 c.task = "predict"
             elif t in ("serve", "serving"):
                 c.task = "serve"
+            elif t in ("ingest", "ingestion"):
+                c.task = "ingest"
             else:
                 log.fatal("Unknown task type %s" % t)
         if "boosting_type" in params:
@@ -445,6 +462,10 @@ class Config:
         set_int("serve_matmul_min_rows")
         set_str("serve_models")
         set_int("serve_fleet_max_models")
+        set_str("ingest_dir")
+        set_int("ingest_memory_budget_mb")
+        set_int("ingest_shard_rows")
+        set_int("ingest_workers")
         set_int("snapshot_period")
         set_str("snapshot_dir")
         set_int("snapshot_keep")
@@ -474,6 +495,12 @@ class Config:
             log.fatal("serve_matmul_min_rows must be >= 1")
         if c.serve_fleet_max_models < 1:
             log.fatal("serve_fleet_max_models must be >= 1")
+        if c.ingest_memory_budget_mb < 8:
+            log.fatal("ingest_memory_budget_mb must be >= 8")
+        if c.ingest_shard_rows < 0:
+            log.fatal("ingest_shard_rows must be >= 0 (0 = auto)")
+        if c.ingest_workers < 0:
+            log.fatal("ingest_workers must be >= 0 (0 = auto)")
         if c.snapshot_period < 0:
             log.fatal("snapshot_period must be >= 0")
         if c.snapshot_keep < 0:

@@ -1,0 +1,20 @@
+"""Out-of-core ingestion subsystem: chunked text -> binned shard
+directories under a memory budget, with resumable manifests and an
+mmap-backed ShardedDataset that feeds training per-shard.
+
+Everything here is jax-free (graftcheck GC002/GC007): ingest is host
+preprocessing, and the parse/shard-write paths must run in jax-free
+lanes (CLI task=ingest, parse worker processes)."""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+from .manifest import (Manifest, ManifestError, is_manifest_path,
+                       manifest_dir)
+from .shards import ShardedDataset, load_sharded_dataset
+from .writer import ingest, run_ingest_cli
+
+__all__ = ["Manifest", "ManifestError", "is_manifest_path",
+           "manifest_dir", "ShardedDataset", "load_sharded_dataset",
+           "ingest", "run_ingest_cli"]

@@ -1,0 +1,187 @@
+"""Ingest manifest: the durable index of a shard directory.
+
+A completed ingest directory holds
+
+    manifest.json            this file — the COMMIT point (written last)
+    bins.npz                 bin-mapper pack + schema (checksummed npz)
+    shard_00000.bins         column-oriented [F, rows] binned payloads
+    shard_00000.meta.npz     per-shard label / weight / qid sidecars
+    ...
+
+The manifest records per-shard row ranges, the source fingerprint
+(path, size, mtime) and the config fingerprint (every key that changes
+bins or row semantics), mirroring the PR 7 snapshot `resume_fp`
+pattern: fingerprints are readable k=v strings, not digests, so a
+rejected manifest names WHICH keys moved.  A directory with bins.npz +
+shards but no manifest.json is a killed ingest — the writer resumes it
+at the first missing/corrupt shard.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..config import Config
+from ..resilience.atomic import atomic_write_bytes
+
+MANIFEST_NAME = "manifest.json"
+#: pre-commit plan (sample pass done, shards in flight) — same schema
+#: as the manifest minus completion; lets a killed ingest resume with
+#: the ALREADY-FOUND bins instead of replaying the sample pass
+PLAN_NAME = "ingest_plan.json"
+BINS_NAME = "bins.npz"
+MANIFEST_VERSION = 1
+
+#: config keys that change the binned representation or the row/label
+#: semantics of the shards — any drift forces a re-ingest (the analog
+#: of snapshot.FP_KEYS for datasets)
+FP_KEYS = ("max_bin", "bin_construct_sample_cnt", "data_random_seed",
+           "label_column", "weight_column", "group_column",
+           "ignore_column", "has_header")
+
+
+class ManifestError(RuntimeError):
+    """A manifest/plan file is missing, malformed, or incomplete."""
+
+
+def shard_name(index: int) -> str:
+    return "shard_%05d.bins" % index
+
+
+def shard_meta_name(index: int) -> str:
+    return "shard_%05d.meta" % index
+
+
+def config_fingerprint(config: Config) -> str:
+    """Readable k=v fingerprint of the bin-affecting config keys."""
+    return ";".join("%s=%r" % (k, getattr(config, k)) for k in FP_KEYS)
+
+
+def source_fingerprint(paths: Sequence[str]) -> str:
+    """Readable fingerprint of the source file list: per-file basename,
+    byte size and mtime (whole seconds: sub-second precision differs
+    across filesystems and copies, while a real edit moves the clock).
+    The `.weight`/`.query` metadata sidecars are fingerprinted too —
+    their values are BAKED into shard metas / `.bin` caches, so an
+    edited sidecar must invalidate exactly like an edited data file
+    (`.init` is not: it applies at training time, never baked)."""
+    parts = []
+    for p in paths:
+        for f in (p, p + ".weight", p + ".query"):
+            if f is not p and not os.path.isfile(f):
+                continue
+            st = os.stat(f)
+            parts.append("%s=size:%d,mtime:%d"
+                         % (os.path.basename(f), st.st_size,
+                            int(st.st_mtime)))
+    return ";".join(parts)
+
+
+def fingerprint_diff(have: str, want: str) -> str:
+    """Key-by-key diff of two k=v fingerprint strings (the rejection
+    message must NAME the moved keys, snapshot.fingerprint_diff's
+    contract)."""
+    h = dict(p.split("=", 1) for p in have.split(";") if "=" in p)
+    w = dict(p.split("=", 1) for p in want.split(";") if "=" in p)
+    keys = sorted(k for k in set(h) | set(w) if h.get(k) != w.get(k))
+    return ", ".join("%s: manifest %s vs run %s"
+                     % (k, h.get(k, "<absent>"), w.get(k, "<absent>"))
+                     for k in keys)
+
+
+@dataclasses.dataclass
+class Manifest:
+    """Schema + shard index of one ingested dataset."""
+    num_rows: int
+    num_features: int          # used (non-trivial) features == shard F
+    num_total_features: int
+    label_idx: int
+    fmt: str                   # tsv | csv | libsvm
+    dtype: str                 # uint8 | uint16
+    shard_rows: int            # rows per full shard (last may be short)
+    shard_row_counts: List[int]
+    feature_names: List[str]
+    has_weights: bool
+    has_query: bool
+    config_fp: str
+    source_fp: str
+    sources: List[str]
+    version: int = MANIFEST_VERSION
+    complete: bool = True
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shard_row_counts)
+
+    def shard_row0(self, index: int) -> int:
+        return sum(self.shard_row_counts[:index])
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=1,
+                          sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Manifest":
+        try:
+            d: Dict[str, Any] = json.loads(text)
+        except ValueError as ex:
+            raise ManifestError("malformed manifest JSON: %s" % ex)
+        fields = {f.name for f in dataclasses.fields(Manifest)}
+        missing = sorted(fields - set(d))
+        if missing:
+            raise ManifestError("manifest missing keys: %s"
+                                % ", ".join(missing))
+        return Manifest(**{k: v for k, v in d.items() if k in fields})
+
+
+def save_manifest(dirpath: str, m: Manifest,
+                  name: str = MANIFEST_NAME) -> None:
+    """Atomic JSON write (tmp+fsync+replace): a SIGKILL at any byte
+    leaves the previous manifest or none — never a truncated one."""
+    atomic_write_bytes(os.path.join(dirpath, name),
+                       m.to_json().encode("utf-8"), checksum=False)
+
+
+def load_manifest(dirpath: str,
+                  name: str = MANIFEST_NAME) -> Optional[Manifest]:
+    """The parsed manifest/plan, or None when absent.  Malformed files
+    raise ManifestError (callers decide between fatal and re-ingest)."""
+    path = os.path.join(dirpath, name)
+    if not os.path.isfile(path):
+        return None
+    with open(path, "rb") as f:
+        return Manifest.from_json(f.read().decode("utf-8", "replace"))
+
+
+def is_manifest_path(path: str) -> bool:
+    """True when `path` names an ingest directory (or its manifest.json
+    directly) — the load_dataset routing probe.  A directory holding
+    only plan/pack artifacts (a KILLED ingest that never committed its
+    manifest) routes here too, so the loader's 're-run task=ingest'
+    diagnostic fires instead of the text parser choking on a
+    directory."""
+    if os.path.basename(path) == MANIFEST_NAME:
+        return os.path.isfile(path)
+    if not os.path.isdir(path):
+        return False
+    return any(os.path.isfile(os.path.join(path, n))
+               for n in (MANIFEST_NAME, PLAN_NAME, BINS_NAME))
+
+
+def manifest_dir(path: str) -> str:
+    """Normalize a manifest path (dir or dir/manifest.json) to the dir."""
+    if os.path.basename(path) == MANIFEST_NAME:
+        return os.path.dirname(path) or "."
+    return path
+
+
+__all__ = ["MANIFEST_NAME", "PLAN_NAME", "BINS_NAME", "FP_KEYS",
+           "Manifest", "ManifestError", "config_fingerprint",
+           "source_fingerprint", "fingerprint_diff", "shard_name",
+           "shard_meta_name", "save_manifest", "load_manifest",
+           "is_manifest_path", "manifest_dir"]

@@ -1,0 +1,513 @@
+"""mmap-backed shard files and the ShardedDataset they serve.
+
+A shard file is one column-oriented block of the binned matrix:
+
+    LGTSHRD1 | u32 version | u32 dtype (1=u8, 2=u16) | i64 F | i64 rows
+    payload: F * rows bytes (times itemsize), feature-major C order
+    40-byte sha256 integrity footer (resilience/atomic)
+
+ShardedDataset satisfies the training-side `Dataset` interface while
+keeping the bin matrix ON DISK: `iter_bin_windows()` yields one
+bounded [F, rows] window per shard (an mmap view, or a copy of just
+this rank's lottery-kept columns), and GBDT device_puts each window
+without ever assembling the full matrix on the host.  Metadata
+(labels, weights, query ids) is O(N) scalars and loads eagerly from
+the per-shard sidecars.
+
+Multi-rank (`tree_learner=data`, num_machines > 1): every rank replays
+the reference's seeded row lottery over the manifest's global row
+order (one NextInt(0, num_machines) draw per row, or per query — the
+exact stream `io/dataset.py` replays for text files), so a rank reads
+only its manifest slice: the kept columns of each shard.  The outcome
+is cached in a `rank_rNofM.rows.npz` sidecar next to the manifest,
+validated the same way the `.bin` cache sidecars are (seed,
+granularity, config fingerprint) before reuse.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..io.binning import BinMapper, unpack_bin_mappers
+from ..io.dataset import (Dataset, Metadata, _check_lottery_query_counts,
+                          _load_sidecar)
+from ..resilience.atomic import (IntegrityError, atomic_writer, read_npz,
+                                 verify_file, write_npz)
+from ..utils import log
+from .manifest import (BINS_NAME, Manifest, config_fingerprint,
+                       fingerprint_diff, load_manifest, manifest_dir,
+                       shard_meta_name, shard_name, source_fingerprint)
+
+SHARD_MAGIC = b"LGTSHRD1"
+SHARD_HEADER_LEN = 32
+_DTYPE_CODES = {"uint8": 1, "uint16": 2}
+
+META_MAGIC = b"LGTSMET1"
+_META_W = 1
+_META_Q = 2
+
+
+def write_shard_meta(path: str, label: np.ndarray,
+                     weights: Optional[np.ndarray],
+                     qid: Optional[np.ndarray]) -> None:
+    """Per-shard label/weight/qid sidecar in a DETERMINISTIC flat
+    binary layout (npz embeds zip timestamps, and a resumed ingest
+    must reproduce a byte-identical shard directory):
+
+        LGTSMET1 | u32 ver | u32 flags | i64 rows |
+        label f32[rows] | weights f32[rows]? | qid i64[rows]? | footer
+    """
+    flags = (_META_W if weights is not None else 0) \
+        | (_META_Q if qid is not None else 0)
+    rows = len(label)
+    with atomic_writer(path, checksum=True) as f:
+        f.write(META_MAGIC + np.uint32(1).tobytes()
+                + np.uint32(flags).tobytes() + np.int64(rows).tobytes())
+        f.write(np.ascontiguousarray(label, dtype=np.float32).tobytes())
+        if weights is not None:
+            f.write(np.ascontiguousarray(weights,
+                                         dtype=np.float32).tobytes())
+        if qid is not None:
+            f.write(np.ascontiguousarray(qid, dtype=np.int64).tobytes())
+
+
+def read_shard_meta(path: str):
+    """(label f32, weights f32 | None, qid i64 | None), checksum-
+    verified (IntegrityError on damage)."""
+    from ..resilience.atomic import read_verified
+    payload = read_verified(path)
+    if payload[:8] != META_MAGIC:
+        raise IntegrityError("%s: not a shard meta file" % path)
+    flags = int(np.frombuffer(payload, np.uint32, 1, 12)[0])
+    rows = int(np.frombuffer(payload, np.int64, 1, 16)[0])
+    o = 24
+    label = np.frombuffer(payload, np.float32, rows, o).copy()
+    o += 4 * rows
+    weights = None
+    if flags & _META_W:
+        weights = np.frombuffer(payload, np.float32, rows, o).copy()
+        o += 4 * rows
+    qid = None
+    if flags & _META_Q:
+        qid = np.frombuffer(payload, np.int64, rows, o).copy()
+    return label, weights, qid
+
+
+def shard_file_size(num_features: int, rows: int, dtype: str) -> int:
+    """Expected on-disk size of a complete shard (header + payload +
+    integrity footer) — the cheap completeness probe."""
+    from ..resilience.atomic import FOOTER_LEN
+    return (SHARD_HEADER_LEN
+            + num_features * rows * np.dtype(dtype).itemsize
+            + FOOTER_LEN)
+
+
+def write_shard(path: str, block: np.ndarray) -> None:
+    """Durable shard write: header + feature-major payload, streamed
+    through the hashing atomic writer (a SIGKILL at any byte leaves no
+    file under the final name)."""
+    f_cnt, rows = block.shape
+    code = _DTYPE_CODES[str(block.dtype)]
+    header = (SHARD_MAGIC
+              + np.uint32(1).tobytes() + np.uint32(code).tobytes()
+              + np.int64(f_cnt).tobytes() + np.int64(rows).tobytes())
+    assert len(header) == SHARD_HEADER_LEN
+    block = np.ascontiguousarray(block)
+    with atomic_writer(path, checksum=True) as f:
+        f.write(header)
+        f.write(memoryview(block).cast("B"))
+
+
+def open_shard(path: str, num_features: int, rows: int,
+               dtype: str) -> np.ndarray:
+    """mmap view [F, rows] of a shard's payload.  Header fields are
+    validated against the manifest; payload bytes are verified only by
+    the resume scan (hashing every shard on every open would re-read
+    the whole dataset per training run)."""
+    with open(path, "rb") as f:
+        head = f.read(SHARD_HEADER_LEN)
+    if len(head) != SHARD_HEADER_LEN or head[:8] != SHARD_MAGIC:
+        raise IntegrityError("%s: not a shard file" % path)
+    code = int(np.frombuffer(head, np.uint32, 1, 12)[0])
+    f_cnt = int(np.frombuffer(head, np.int64, 1, 16)[0])
+    r = int(np.frombuffer(head, np.int64, 1, 24)[0])
+    if (code != _DTYPE_CODES[dtype] or f_cnt != num_features
+            or r != rows):
+        raise IntegrityError(
+            "%s: header (F=%d rows=%d dtype=%d) does not match the "
+            "manifest (F=%d rows=%d dtype=%s)"
+            % (path, f_cnt, r, code, num_features, rows, dtype))
+    return np.memmap(path, dtype=np.dtype(dtype), mode="r",
+                     offset=SHARD_HEADER_LEN,
+                     shape=(num_features, rows))
+
+
+def shard_is_valid(dirpath: str, m: Manifest, index: int,
+                   deep: bool = False) -> bool:
+    """Completeness probe for shard `index`: expected size + readable
+    meta sidecar; `deep` additionally streams the sha256 of the shard
+    payload (the resume scan — external damage must not survive)."""
+    p = os.path.join(dirpath, shard_name(index))
+    rows = m.shard_row_counts[index]
+    try:
+        if os.path.getsize(p) != shard_file_size(m.num_features, rows,
+                                                 m.dtype):
+            return False
+    except OSError:
+        return False
+    if deep and verify_file(p) != "ok":
+        return False
+    meta = os.path.join(dirpath, shard_meta_name(index))
+    try:
+        label, _, _ = read_shard_meta(meta)
+        if len(label) != rows:
+            return False
+    except Exception:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# ShardedDataset
+# ---------------------------------------------------------------------------
+
+class ShardedDataset(Dataset):
+    """A `Dataset` whose bin matrix lives in shard files.
+
+    The training path feeds from `iter_bin_windows()` (one bounded
+    window at a time); the `bins` property still materializes the full
+    local matrix for the few legacy paths that need host bins (custom-
+    gradient excursions, checkpoint restore with a row re-sort, query-
+    granular layouts) — with a log line, because those paths forfeit
+    the out-of-core property."""
+
+    is_shard_backed = True
+
+    def __init__(self, dirpath: str, manifest: Manifest,
+                 bin_mappers: List[BinMapper],
+                 used_feature_map: np.ndarray,
+                 real_feature_index: np.ndarray,
+                 metadata: Metadata, label_idx: int,
+                 local_rows: Optional[np.ndarray],
+                 shard_keeps: Optional[List[np.ndarray]]):
+        # deliberately NOT the dataclass __init__: `bins` is a property
+        self.dir = dirpath
+        self.manifest = manifest
+        self.bin_mappers = bin_mappers
+        self.used_feature_map = used_feature_map
+        self.real_feature_index = real_feature_index
+        self.num_total_features = manifest.num_total_features
+        self.feature_names = list(manifest.feature_names)
+        self.metadata = metadata
+        self.label_idx = label_idx
+        self.local_rows = local_rows
+        #: per-shard kept-column indices (None = every row kept)
+        self._shard_keeps = shard_keeps
+        self._n_local = (len(metadata.label))
+        self._warned_materialize = False
+        self._bins_cache: Optional[np.ndarray] = None
+
+    # -- Dataset interface overrides (no bins attribute) ---------------
+    @property
+    def num_data(self) -> int:
+        return self._n_local
+
+    @property
+    def num_features(self) -> int:
+        return self.manifest.num_features
+
+    @property
+    def bin_dtype(self) -> np.dtype:
+        return np.dtype(self.manifest.dtype)
+
+    @property
+    def bins(self) -> np.ndarray:
+        """Materialized [F, n_local] matrix — legacy-path fallback ONLY
+        (it exists so ordered-partition restores and general-path
+        excursions still work); the fed training path never calls it.
+        Cached after the first access: the out-of-core property is
+        already forfeited then, and repeat accessors (general-path
+        excursions re-place bins per excursion) must not pay a full
+        shard-directory disk read each time."""
+        if self._bins_cache is None:
+            self._warned_materialize = True
+            log.info("ShardedDataset: materializing the full [%d, %d] "
+                     "bin matrix on the host (a non-streaming code "
+                     "path asked for Dataset.bins; cached from here "
+                     "on)" % (self.num_features, self._n_local))
+            self._bins_cache = self.local_bins_matrix()
+        return self._bins_cache
+
+    # -- streaming access ----------------------------------------------
+    def iter_bin_windows(self) -> Iterator[np.ndarray]:
+        """Yield one [F, k] window per shard, in global row order:
+        an mmap view when every row is kept, else a copy of just this
+        rank's kept columns.  Peak host memory is one window."""
+        m = self.manifest
+        for i in range(m.num_shards):
+            mm = open_shard(os.path.join(self.dir, shard_name(i)),
+                            m.num_features, m.shard_row_counts[i],
+                            m.dtype)
+            if self._shard_keeps is None:
+                yield mm
+            else:
+                idx = self._shard_keeps[i]
+                if len(idx):
+                    yield np.ascontiguousarray(mm[:, idx])
+            del mm
+
+    def local_bins_matrix(self) -> np.ndarray:
+        """[F, n_local] host matrix of this rank's kept rows (the
+        multi-host assembly block — 1/R of the data per rank)."""
+        parts = [np.asarray(w) for w in self.iter_bin_windows()]
+        if not parts:
+            return np.zeros((self.num_features, 0),
+                            dtype=self.bin_dtype)
+        return np.ascontiguousarray(np.concatenate(parts, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def _load_bins_pack(dirpath: str):
+    """(mappers, used_feature_map, real_index, qcounts-or-None) from
+    the checksummed bins.npz pack."""
+    with read_npz(os.path.join(dirpath, BINS_NAME)) as z:
+        mappers = unpack_bin_mappers(np.asarray(z["packed_mappers"]))
+        ufm = np.asarray(z["used_feature_map"], dtype=np.int32)
+        real = np.asarray(z["real_feature_index"], dtype=np.int32)
+        qcounts = (np.asarray(z["qcounts"], dtype=np.int64)
+                   if "qcounts" in z.files else None)
+    return mappers, ufm, real, qcounts
+
+
+def _rank_sidecar_path(dirpath: str, rank: int, num_shards: int) -> str:
+    return os.path.join(dirpath, "rank_r%dof%d.rows.npz"
+                        % (rank, num_shards))
+
+
+def _load_rank_sidecar(dirpath: str, m: Manifest, config: Config,
+                       rank: int, num_shards: int,
+                       want_query: bool) -> Optional[np.ndarray]:
+    """Cached lottery outcome for this rank, or None when absent/stale.
+    Stale = different seed, granularity, config fingerprint or row
+    count — the same never-silently-reuse rule as _rank_cache_matches
+    (a stale partition would desync the cluster's row sets)."""
+    path = _rank_sidecar_path(dirpath, rank, num_shards)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with read_npz(path) as z:
+            if ("seed" not in z.files or "query_lottery" not in z.files
+                    or "config_fp" not in z.files
+                    or "n_global" not in z.files):
+                return None
+            if (int(z["seed"]) != int(config.data_random_seed)
+                    or bool(int(z["query_lottery"])) != want_query
+                    or int(z["n_global"]) != m.num_rows):
+                return None
+            fp = bytes(np.asarray(z["config_fp"]).tobytes()).decode(
+                "utf-8", "replace")
+            if fp != m.config_fp:
+                return None
+            return np.asarray(z["rows"], dtype=np.int64)
+    except Exception:
+        return None
+
+
+def _lottery_keep(m: Manifest, qcounts: Optional[np.ndarray],
+                  qid_all: Optional[np.ndarray], config: Config,
+                  rank: int, num_shards: int) -> np.ndarray:
+    """[num_rows] bool keep mask from the reference's seeded row
+    lottery — row-granular, or query-granular when the manifest
+    carries query structure (whole queries stay on one rank)."""
+    from .. import native
+    n = m.num_rows
+    lot = native.ShardLottery(config.data_random_seed, num_shards,
+                              rank, -1)
+    heads = None
+    if qcounts is not None:
+        _check_lottery_query_counts(qcounts, m.sources[0] + ".query")
+        heads = np.zeros(n, dtype=np.uint8)
+        heads[np.concatenate([[0], np.cumsum(qcounts)[:-1]])
+              .astype(np.int64)] = 1
+    elif qid_all is not None:
+        heads = np.empty(n, dtype=np.uint8)
+        heads[0] = 1
+        heads[1:] = (np.diff(qid_all) != 0).astype(np.uint8)
+    keep, _ = lot.chunk(n, heads)
+    if not keep.any():
+        log.fatal("Rank %d's row-lottery shard of %s is empty "
+                  "(%d rows over %d machines); use fewer machines "
+                  "or pre-partitioned shard directories"
+                  % (rank, m.sources[0], n, num_shards))
+    return keep
+
+
+def load_sharded_dataset(path: str, config: Config, rank: int = 0,
+                         num_shards: int = 1) -> ShardedDataset:
+    """Load an ingest directory as a training Dataset.
+
+    The manifest's CONFIG fingerprint must match the run's (max_bin,
+    column specs, seed, ... — manifest.FP_KEYS); on mismatch the
+    loader re-ingests from the recorded sources when they still exist
+    (warning naming the moved keys, the snapshot `resume_fp` pattern),
+    and fatals naming them when they do not."""
+    dirpath = manifest_dir(path)
+    m = load_manifest(dirpath)
+    if m is None:
+        log.fatal("No manifest.json under %s (not an ingest directory, "
+                  "or a killed ingest that never finished — re-run "
+                  "task=ingest)" % dirpath)
+    run_fp = config_fingerprint(config)
+    why = None
+    if m.config_fp != run_fp:
+        why = ("config mismatch: "
+               + fingerprint_diff(m.config_fp, run_fp))
+    elif verify_file(os.path.join(dirpath, BINS_NAME)) != "ok":
+        why = "missing/corrupt bins.npz mapper pack"
+    elif all(os.path.isfile(s) for s in m.sources):
+        # sources still present: an edited data file (or baked
+        # .weight/.query sidecar) must not serve stale shards.  GONE
+        # sources are fine — the manifest is a standalone artifact,
+        # same rule as the .bin caches.
+        run_src = source_fingerprint(m.sources)
+        if m.source_fp != run_src:
+            why = ("source drift: "
+                   + fingerprint_diff(m.source_fp, run_src))
+    if why is not None:
+        if all(os.path.isfile(s) for s in m.sources):
+            log.warning("Ingest manifest %s does not match this run "
+                        "(%s): re-ingesting from %s"
+                        % (dirpath, why, ",".join(m.sources)))
+            from .writer import ingest
+            m = ingest(m.sources, dirpath, config)
+        else:
+            log.fatal("Ingest manifest %s is unusable (%s) and its "
+                      "sources are gone — cannot re-ingest"
+                      % (dirpath, why))
+
+    mappers, ufm, real, qcounts = _load_bins_pack(dirpath)
+    if len(mappers) != m.num_features:
+        log.fatal("bins.npz pack (%d mappers) does not match manifest "
+                  "(%d features) under %s"
+                  % (len(mappers), m.num_features, dirpath))
+
+    # per-shard metadata sidecars -> global arrays (O(N) scalars)
+    labels, weights, qids = [], [], []
+    for i in range(m.num_shards):
+        lab, w, q = read_shard_meta(
+            os.path.join(dirpath, shard_meta_name(i)))
+        labels.append(lab)
+        if w is not None:
+            weights.append(w)
+        if q is not None:
+            qids.append(q)
+    label_all = (np.concatenate(labels) if labels
+                 else np.zeros(0, np.float32))
+    if len(label_all) != m.num_rows:
+        log.fatal("Shard metadata rows (%d) do not match manifest "
+                  "row count (%d) under %s"
+                  % (len(label_all), m.num_rows, dirpath))
+    weights_all = np.concatenate(weights) if weights else None
+    qid_all = np.concatenate(qids) if qids else None
+
+    sharding = num_shards > 1 and not config.is_pre_partition
+    keep = local_rows = shard_keeps = None
+    if sharding:
+        want_query = qcounts is not None or qid_all is not None
+        local_rows = _load_rank_sidecar(dirpath, m, config, rank,
+                                        num_shards, want_query)
+        if local_rows is not None:
+            keep = np.zeros(m.num_rows, dtype=bool)
+            keep[local_rows] = True
+        else:
+            keep = _lottery_keep(m, qcounts, qid_all, config, rank,
+                                 num_shards)
+            local_rows = np.nonzero(keep)[0].astype(np.int64)
+            try:
+                write_npz(_rank_sidecar_path(dirpath, rank, num_shards),
+                          dict(rows=local_rows,
+                               n_global=np.int64(m.num_rows),
+                               seed=np.int64(config.data_random_seed),
+                               query_lottery=np.int64(want_query),
+                               config_fp=np.frombuffer(
+                                   m.config_fp.encode("utf-8"),
+                                   dtype=np.uint8).copy()))
+            except OSError as ex:   # read-only shard dir: lottery is cheap
+                log.warning("Could not cache rank partition sidecar "
+                            "under %s: %s" % (dirpath, ex))
+        shard_keeps = []
+        row0 = 0
+        for rows in m.shard_row_counts:
+            shard_keeps.append(
+                np.flatnonzero(keep[row0:row0 + rows]).astype(np.int64))
+            row0 += rows
+
+    # query boundaries (local rows): whole queries survive the lottery
+    # together, so boundaries rebuild from kept heads / kept counts
+    qb = None
+    if qcounts is not None:
+        if keep is not None:
+            hpos = np.concatenate([[0], np.cumsum(qcounts)[:-1]]) \
+                .astype(np.int64)
+            qsel = keep[hpos]
+            qb = np.concatenate(
+                [[0], np.cumsum(qcounts[qsel])]).astype(np.int32)
+        else:
+            qb = np.concatenate(
+                [[0], np.cumsum(qcounts)]).astype(np.int32)
+    elif qid_all is not None:
+        q = qid_all[keep] if keep is not None else qid_all
+        if keep is not None:
+            heads = np.empty(m.num_rows, dtype=bool)
+            heads[0] = True
+            heads[1:] = np.diff(qid_all) != 0
+            kept_heads = heads[keep]
+            qb = np.concatenate(
+                [np.flatnonzero(kept_heads), [len(q)]]).astype(np.int32)
+        else:
+            change = np.nonzero(np.diff(q))[0] + 1
+            qb = np.concatenate([[0], change, [len(q)]]).astype(np.int32)
+
+    if keep is not None:
+        label_all = label_all[keep]
+        if weights_all is not None:
+            weights_all = weights_all[keep]
+
+    # .init sidecar of the ORIGINAL source still applies (it is row-
+    # aligned with the global order the shards preserve)
+    init = _load_sidecar(m.sources[0] + ".init") \
+        if len(m.sources) == 1 else None
+    if init is not None and keep is not None:
+        if len(init) % m.num_rows:
+            log.warning("Ignoring init score file: %d values do not "
+                        "tile %d rows" % (len(init), m.num_rows))
+            init = None
+        else:
+            kcls = len(init) // m.num_rows
+            init = np.ascontiguousarray(
+                np.asarray(init).reshape(kcls, m.num_rows)[:, keep]
+            ).reshape(-1)
+
+    metadata = Metadata(label=label_all, weights=weights_all,
+                        query_boundaries=qb, init_score=init)
+    metadata.finish_queries()
+    ds = ShardedDataset(dirpath, m, mappers, ufm, real, metadata,
+                        m.label_idx, local_rows, shard_keeps)
+    log.info("Loaded ingest manifest %s: %d features, %d/%d rows "
+             "(%d shards)" % (dirpath, ds.num_features, ds.num_data,
+                              m.num_rows, m.num_shards))
+    return ds
+
+
+__all__ = ["SHARD_MAGIC", "SHARD_HEADER_LEN", "ShardedDataset",
+           "write_shard", "open_shard", "shard_is_valid",
+           "shard_file_size", "load_sharded_dataset"]

@@ -1,0 +1,115 @@
+"""Synthetic Criteo-class row generator (ingest benchmarking/tests).
+
+Criteo-1TB rows are a click label + 13 skewed numeric counters + 26
+hashed categoricals.  This pipeline is numeric (categorical splits are
+a ROADMAP item), so the generator emits the numeric shape of that
+workload: a binary label, heavy-tailed integer counters, and dense
+floats with a configurable zero rate (sparse-ish columns), as TSV or
+LibSVM.  Generation tiles one deterministic block (content variety
+only matters to bin finding, which samples anyway), so multi-GB files
+write at IO speed with O(block) memory.
+
+Not a parity path: rows are synthetic by definition (np.random is the
+deliberate choice here; the parity-load-bearing ingest modules stay on
+utils/mt19937)."""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..resilience.atomic import atomic_writer
+
+#: Criteo-like numeric schema: 13 counters + 15 dense floats
+N_COUNTERS = 13
+N_DENSE = 15
+NUM_FEATURES = N_COUNTERS + N_DENSE
+
+
+def _block(rows: int, seed: int, zero_rate: float) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    counters = np.floor(
+        rng.lognormal(mean=1.5, sigma=1.8,
+                      size=(rows, N_COUNTERS))).astype(np.float64)
+    dense = rng.randn(rows, N_DENSE)
+    x = np.concatenate([counters, dense], axis=1)
+    x[rng.rand(rows, NUM_FEATURES) < zero_rate] = 0.0
+    logit = (0.8 * np.log1p(x[:, 0]) + 0.5 * x[:, N_COUNTERS]
+             - 0.3 * x[:, N_COUNTERS + 1] - 1.0)
+    y = (logit + rng.logistic(size=rows) > 0).astype(np.int64)
+    return np.concatenate([y[:, None].astype(np.float64), x], axis=1)
+
+
+def _format_block(block: np.ndarray, fmt: str) -> bytes:
+    lines = []
+    for row in block:
+        label = "%d" % int(row[0])
+        if fmt == "libsvm":
+            toks = [label] + ["%d:%.6g" % (j, v)
+                              for j, v in enumerate(row[1:]) if v != 0.0]
+            lines.append(" ".join(toks))
+        else:
+            lines.append("\t".join([label] + ["%.6g" % v
+                                              for v in row[1:]]))
+    return ("\n".join(lines) + "\n").encode()
+
+
+def generate(path: str, target_bytes: int = 0, rows: int = 0,
+             fmt: str = "tsv", seed: int = 0, zero_rate: float = 0.25,
+             block_rows: int = 20000) -> int:
+    """Write a synthetic data file of at least `target_bytes` bytes (or
+    exactly `rows` rows when given).  Returns the row count.  The write
+    is atomic — a partial generation never masquerades as a complete
+    benchmark input."""
+    assert fmt in ("tsv", "libsvm"), fmt
+    blocks = []
+    for i in range(4):   # 4 distinct blocks tile with some variety
+        blocks.append(_format_block(
+            _block(block_rows, seed * 31 + i, zero_rate), fmt))
+    written_rows = 0
+    with atomic_writer(path, checksum=False) as f:
+        if rows > 0:
+            left = rows
+            i = 0
+            while left > 0:
+                if left >= block_rows:
+                    f.write(blocks[i % len(blocks)])
+                    left -= block_rows
+                else:
+                    b = _format_block(
+                        _block(left, seed * 31 + i % 4, zero_rate), fmt)
+                    f.write(b)
+                    left = 0
+                i += 1
+            written_rows = rows
+        else:
+            written = 0
+            i = 0
+            while written < target_bytes:
+                b = blocks[i % len(blocks)]
+                f.write(b)
+                written += len(b)
+                written_rows += block_rows
+                i += 1
+    return written_rows
+
+
+def cached_file(cache_dir: str, target_bytes: int, fmt: str = "tsv",
+                seed: int = 0) -> Optional[str]:
+    """Benchmark convenience: generate-once-and-reuse by size under
+    `cache_dir`."""
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, "synth_%s_%d.%s"
+                        % (fmt, target_bytes,
+                           "libsvm" if fmt == "libsvm" else "tsv"))
+    if not (os.path.isfile(path)
+            and os.path.getsize(path) >= target_bytes):
+        generate(path, target_bytes=target_bytes, fmt=fmt, seed=seed)
+    return path
+
+
+__all__ = ["generate", "cached_file", "NUM_FEATURES"]

@@ -1,0 +1,667 @@
+"""Out-of-core text -> binned-shard ingestion under a memory budget.
+
+The reference solves TB-scale loading with two-round streaming
+(DatasetLoader sample-based `CostructFromSampleData` + a second pass
+that writes bins directly, dataset_loader.cpp:170-185).  This module
+is that design taken out-of-core: instead of quantizing into one
+host-resident [F, N] matrix, the second pass writes fixed-row-count
+column-oriented shard files, so neither the text NOR the binned matrix
+ever lives whole in host memory.
+
+Passes (both streaming, chunk_bytes at a time):
+
+  1. sample pass — count rows, reservoir-sample
+     `bin_construct_sample_cnt` lines on the seeded mt19937
+     (io/dataset.reservoir_offer, the EXACT stream `_load_two_round`
+     replays, so ingest bins == two-round text bins bit-for-bit), find
+     bins via io/binning.find_bin (or a caller-supplied hook wrapping
+     find_bins_distributed for multi-rank ingest).  Writes `bins.npz`
+     (mapper pack) + `ingest_plan.json`.
+  2. bin pass — N parallel parse workers (multiprocessing, reusing
+     io/parser) quantize chunks straight to uint8/16 columns; the
+     parent assembles fixed-row-count shards and commits each through
+     resilience/atomic (sha-footered, crash-safe), with the
+     `ingest.shard_write` faultpoint ahead of every commit.  The
+     manifest.json commit (written LAST) marks completion.
+
+Resume: a killed ingest leaves plan + bins.npz + a prefix of valid
+shards.  The next run fingerprint-checks the plan, deep-verifies the
+shard prefix, and re-streams the source skipping already-binned rows
+(an IO-only line scan — no re-parse, no re-bin) before continuing at
+the first missing shard.  The result is byte-identical to an
+uninterrupted ingest (chaos-tested).
+
+Memory budget (`ingest_memory_budget_mb`): bounds the chunk size, the
+in-flight worker results and the shard assembly buffer.  O(N) scalars
+(labels; the reservoir sample) are outside the per-chunk budget but
+small: ~4 bytes/row and `bin_construct_sample_cnt` lines.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import os
+import sys
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..io.binning import BinMapper, pack_bin_mappers
+from ..io.dataset import (_chunk_line_spans, _load_sidecar,
+                          _scan_libsvm_max_idx, _skip_header,
+                          _stream_line_chunks, reservoir_offer,
+                          resolve_sample_schema)
+from ..io.parser import detect_format, parse_file_bytes
+from ..resilience.atomic import write_npz
+from ..resilience.faults import faultpoint
+from ..utils import log
+from ..utils.mt19937 import Mt19937Random
+from .manifest import (BINS_NAME, MANIFEST_NAME, PLAN_NAME, Manifest,
+                       ManifestError, config_fingerprint,
+                       fingerprint_diff, load_manifest, save_manifest,
+                       shard_meta_name, shard_name, source_fingerprint)
+from .shards import shard_is_valid, write_shard, write_shard_meta
+
+#: type of the optional bin-finding hook: (sample_used_cols [S, U] f64,
+#: total_sample_cnt) -> List[BinMapper] for the used columns, in order.
+#: Multi-rank ingests pass a wrapper over io/binning.
+#: find_bins_distributed so every rank lands identical mappers.
+FindBinsFn = Callable[[np.ndarray, int], List[BinMapper]]
+
+
+def source_list(data_spec: str) -> List[str]:
+    """data= value -> ordered source file list (comma-separated for a
+    sharded file set); every entry must exist."""
+    out = [s.strip() for s in data_spec.split(",") if s.strip()]
+    if not out:
+        log.fatal("task=ingest needs data=<file>[,<file>...]")
+    for p in out:
+        if not os.path.isfile(p):
+            log.fatal("Ingest source %s does not exist" % p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# budget plan
+# ---------------------------------------------------------------------------
+
+#: smallest chunk the pipeline will use — below this the per-chunk
+#: python/IPC overhead dominates the parse itself
+_CHUNK_FLOOR = 1 << 18
+
+
+def _auto_workers(config: Config) -> int:
+    """Parse worker count.  Explicit ingest_workers is operator-owned;
+    auto additionally respects the memory budget — every in-flight
+    chunk costs ~6x its bytes, so a tight budget caps the fan-out
+    rather than silently overrunning (the budget is HARD)."""
+    if config.ingest_workers > 0:
+        return config.ingest_workers
+    budget = max(int(config.ingest_memory_budget_mb), 8) << 20
+    by_budget = (budget // 2) // (6 * _CHUNK_FLOOR) - 2
+    return max(1, min(4, os.cpu_count() or 1, by_budget))
+
+
+def _plan_chunk_bytes(config: Config, workers: int) -> int:
+    """Per-chunk byte size: each in-flight chunk costs ~6x its size
+    (raw bytes + the parsed f64 row block + the binned columns) and up
+    to workers + 2 chunks are in flight, so budget/2 bounds the parse
+    pipeline and budget/4 the shard buffer (below)."""
+    budget = max(int(config.ingest_memory_budget_mb), 8) << 20
+    per = (budget // 2) // (6 * (workers + 2))
+    return int(min(max(per, _CHUNK_FLOOR), 32 << 20))
+
+
+def _plan_shard_rows(config: Config, num_features: int,
+                     itemsize: int = 1) -> int:
+    """Rows per shard: the [F, shard_rows] assembly buffer must fit in
+    budget/4 (one shard is also the training-side feeding window).
+    `itemsize` keeps uint16 bins honest against the same bound."""
+    if config.ingest_shard_rows > 0:
+        return config.ingest_shard_rows
+    budget = max(int(config.ingest_memory_budget_mb), 8) << 20
+    rows = (budget // 4) // max(num_features * itemsize, 1)
+    return int(min(max(rows, 4096), 1 << 23))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: sample
+# ---------------------------------------------------------------------------
+
+class _Schema:
+    """Resolved file schema + bin mappers (the sample-pass product)."""
+
+    def __init__(self) -> None:
+        self.names: List[str] = []
+        self.fmt: str = "tsv"
+        self.label_idx: int = 0
+        self.ncols: int = 0            # feature columns (label removed)
+        self.weight_idx: int = -1      # shifted feature-space index
+        self.group_idx: int = -1
+        self.bin_mappers: List[BinMapper] = []
+        self.used_feature_map: np.ndarray = np.zeros(0, np.int32)
+        self.real_feature_index: np.ndarray = np.zeros(0, np.int32)
+        self.n_total: int = 0
+        self.dtype: str = "uint8"
+
+
+def _sample_pass(sources: Sequence[str], config: Config,
+                 chunk_bytes: int) -> Tuple[List[str], Optional[str],
+                                            bytes, int, List[bytes],
+                                            int]:
+    """Streaming round 1 over the source list: row count + reservoir
+    sample (bit-exact `_load_two_round` stream) + libsvm width scan."""
+    target = max(1, config.bin_construct_sample_cnt)
+    rng = Mt19937Random(config.data_random_seed)
+    kept: List[bytes] = []
+    seen = 0
+    n_total = 0
+    fmt: Optional[str] = None
+    libsvm_max = -1
+    first_line = b""
+    names: Optional[List[str]] = None
+    for path in sources:
+        with open(path, "rb") as f:
+            nm = _skip_header(f, config)
+            if names is None:
+                names = nm
+            for chunk in _stream_line_chunks(f, chunk_bytes):
+                starts, lens = _chunk_line_spans(chunk)
+                k = len(starts)
+                if k == 0:
+                    continue
+                if fmt is None:
+                    l2 = [bytes(chunk[int(starts[t]):
+                                      int(starts[t] + lens[t])])
+                          for t in range(min(2, k))]
+                    first_line = l2[0]
+                    fmt = detect_format([ln.decode("utf-8", "replace")
+                                         for ln in l2])
+                if fmt == "libsvm":
+                    libsvm_max = max(libsvm_max,
+                                     _scan_libsvm_max_idx(chunk))
+                n_total += k
+                seen = reservoir_offer(kept, rng, target, seen, chunk,
+                                       starts, lens)
+    if n_total == 0:
+        log.fatal("Data file %s is empty" % ",".join(sources))
+    return names or [], fmt, first_line, libsvm_max, kept, n_total
+
+
+def _resolve_schema(names: List[str], fmt: Optional[str],
+                    first_line: bytes, libsvm_max: int,
+                    kept: List[bytes], n_total: int, config: Config,
+                    find_bins_fn: Optional[FindBinsFn]) -> _Schema:
+    """Schema + mappers from the reservoir sample, via the SHARED
+    io/dataset.resolve_sample_schema — the ingest writer and the
+    two-round text loader resolve columns with the same code, so their
+    bins-parity contract cannot drift."""
+    rs = resolve_sample_schema(kept, names, fmt, first_line, libsvm_max,
+                               config, find_bins_hook=find_bins_fn,
+                               what="ingest sources")
+    s = _Schema()
+    s.n_total = n_total
+    s.names = rs.names
+    s.fmt = rs.fmt
+    s.label_idx = rs.label_idx
+    s.ncols = rs.ncols
+    s.weight_idx = rs.weight_idx
+    s.group_idx = rs.group_idx
+    s.used_feature_map = rs.used_feature_map
+    s.bin_mappers = rs.bin_mappers
+    s.real_feature_index = rs.real_feature_index
+    s.dtype = ("uint8"
+               if max(m.num_bin for m in rs.bin_mappers) <= 256
+               else "uint16")
+    return s
+
+
+# ---------------------------------------------------------------------------
+# pass 2: parallel parse + quantize workers
+# ---------------------------------------------------------------------------
+
+#: worker-process state installed by _init_worker (multiprocessing
+#: initializer; also used inline when ingest_workers resolves to 1)
+_W: dict = {}
+
+
+def _init_worker(packed: np.ndarray, real_index: np.ndarray,
+                 label_idx: int, fmt: str, ncols: int, weight_idx: int,
+                 group_idx: int, dtype: str) -> None:
+    from ..io.binning import unpack_bin_mappers
+    _W.clear()
+    _W.update(mappers=unpack_bin_mappers(packed),
+              real_index=np.asarray(real_index, dtype=np.int64),
+              label_idx=label_idx, fmt=fmt, ncols=ncols,
+              weight_idx=weight_idx, group_idx=group_idx,
+              dtype=np.dtype(dtype))
+
+
+def _bin_chunk_task(raw: bytes) -> Tuple[np.ndarray, np.ndarray,
+                                         Optional[np.ndarray],
+                                         Optional[np.ndarray]]:
+    """One chunk: parse (io/parser — reference Atof semantics) and
+    quantize (BinMapper.value_to_bin) -> ([F, k] bins, [k] label,
+    weights, qid).  Mirrors `_load_two_round` round 2's fallback path
+    exactly, so shard bytes match the in-memory loader's bins."""
+    g = _W
+    chunk = b"\n".join(ln for ln in raw.split(b"\n") if ln) + b"\n"
+    f_cnt = len(g["mappers"])
+    if chunk == b"\n":
+        return (np.zeros((f_cnt, 0), g["dtype"]),
+                np.zeros(0, np.float32), None, None)
+    clabel, cfeats, _ = parse_file_bytes(chunk, g["label_idx"],
+                                         g["fmt"])
+    ncols = g["ncols"]
+    if cfeats.shape[1] < ncols:
+        cfeats = np.pad(cfeats, ((0, 0), (0, ncols - cfeats.shape[1])))
+    elif cfeats.shape[1] > ncols:
+        cfeats = cfeats[:, :ncols]
+    k = len(clabel)
+    bins = np.empty((f_cnt, k), dtype=g["dtype"])
+    for inner, real in enumerate(g["real_index"]):
+        bins[inner] = g["mappers"][inner].value_to_bin(
+            cfeats[:, real]).astype(g["dtype"])
+    w = (cfeats[:, g["weight_idx"]].astype(np.float32)
+         if g["weight_idx"] >= 0 else None)
+    q = (cfeats[:, g["group_idx"]].astype(np.int64)
+         if g["group_idx"] >= 0 else None)
+    return bins, clabel.astype(np.float32), w, q
+
+
+def _make_pool(workers: int, initargs: tuple):
+    """multiprocessing pool for the parse workers.  `fork` shares the
+    parent's pages (cheap); once jax is loaded in this process its
+    runtime threads make fork unsafe, so fall back to `spawn` (workers
+    re-import only the jax-free ingest closure)."""
+    import multiprocessing
+
+    method = "fork"
+    if "jax" in sys.modules or "fork" not in \
+            multiprocessing.get_all_start_methods():
+        method = "spawn"
+    ctx = multiprocessing.get_context(method)
+    return ctx.Pool(workers, initializer=_init_worker,
+                    initargs=initargs)
+
+
+class _ShardAssembler:
+    """Order-preserving assembly of parsed chunks into fixed-row-count
+    shards, committed through the atomic writer with the
+    `ingest.shard_write` faultpoint ahead of every commit."""
+
+    def __init__(self, out_dir: str, plan: Manifest, schema: _Schema,
+                 first_shard: int,
+                 weights_sidecar: Optional[np.ndarray]):
+        self.out = out_dir
+        self.plan = plan
+        self.schema = schema
+        f_cnt = plan.num_features
+        rows = plan.shard_rows
+        self.buf = np.zeros((f_cnt, rows), dtype=np.dtype(plan.dtype))
+        self.lab = np.zeros(rows, dtype=np.float32)
+        self.wcol = (np.zeros(rows, dtype=np.float32)
+                     if schema.weight_idx >= 0 else None)
+        self.qid = (np.zeros(rows, dtype=np.int64)
+                    if schema.group_idx >= 0 else None)
+        self.shard = first_shard
+        self.fill = 0
+        self.row0 = plan.shard_row0(first_shard)   # global row counter
+        self.wside = weights_sidecar
+
+    def consume(self, result) -> None:
+        bins, label, w, q = result
+        k = len(label)
+        o = 0
+        while o < k:
+            cap = self.plan.shard_row_counts[self.shard]
+            take = min(cap - self.fill, k - o)
+            self.buf[:, self.fill:self.fill + take] = bins[:, o:o + take]
+            self.lab[self.fill:self.fill + take] = label[o:o + take]
+            if self.wcol is not None and w is not None:
+                self.wcol[self.fill:self.fill + take] = w[o:o + take]
+            if self.qid is not None and q is not None:
+                self.qid[self.fill:self.fill + take] = q[o:o + take]
+            self.fill += take
+            o += take
+            if self.fill == cap:
+                self._flush(cap)
+
+    def _flush(self, used: int) -> None:
+        i = self.shard
+        # the chaos seam: a SIGKILL here (or inside the writes — they
+        # are atomic) loses at most THIS shard; resume re-bins it
+        faultpoint("ingest.shard_write")
+        write_shard(os.path.join(self.out, shard_name(i)),
+                    self.buf[:, :used])
+        w = None
+        if self.plan.has_weights:
+            if self.wside is not None:
+                w = np.asarray(self.wside[self.row0:self.row0 + used],
+                               dtype=np.float32)
+            elif self.wcol is not None:
+                w = self.wcol[:used]
+        write_shard_meta(os.path.join(self.out, shard_meta_name(i)),
+                         self.lab[:used], w,
+                         self.qid[:used] if self.qid is not None
+                         else None)
+        self.row0 += used
+        self.shard += 1
+        self.fill = 0
+
+    def finish(self) -> None:
+        if self.fill:
+            assert self.fill == self.plan.shard_row_counts[self.shard], \
+                "shard %d assembled %d rows, plan says %d" \
+                % (self.shard, self.fill,
+                   self.plan.shard_row_counts[self.shard])
+            self._flush(self.fill)
+        assert self.shard == self.plan.num_shards, \
+            "assembled %d shards, plan says %d" \
+            % (self.shard, self.plan.num_shards)
+
+
+def _chunks_skipping(sources: Sequence[str], config: Config,
+                     chunk_bytes: int, skip_rows: int):
+    """Stream line chunks across the source list, skipping the first
+    `skip_rows` data rows with an IO-only line scan (resume: rows
+    already committed to valid shards are never re-parsed)."""
+    remaining = skip_rows
+    for path in sources:
+        with open(path, "rb") as f:
+            _skip_header(f, config)
+            for chunk in _stream_line_chunks(f, chunk_bytes):
+                if remaining > 0:
+                    starts, lens = _chunk_line_spans(chunk)
+                    k = len(starts)
+                    if k <= remaining:
+                        remaining -= k
+                        continue
+                    chunk = chunk[int(starts[remaining]):]
+                    remaining = 0
+                yield chunk
+
+
+def _bin_pass(sources: Sequence[str], config: Config, schema: _Schema,
+              plan: Manifest, out_dir: str, first_shard: int,
+              chunk_bytes: int, workers: int) -> None:
+    wside = None
+    if plan.has_weights and len(sources) == 1:
+        w = _load_sidecar(sources[0] + ".weight")
+        if w is not None:
+            if len(w) != plan.num_rows:
+                # Metadata::LoadWeights' rule (metadata.cpp): a
+                # mis-sized sidecar must fatal, not write shards whose
+                # meta rows disagree with their weight payloads
+                log.fatal("Weights file %s.weight has %d values for "
+                          "%d data rows" % (sources[0], len(w),
+                                            plan.num_rows))
+            wside = w.astype(np.float32)
+    asm = _ShardAssembler(out_dir, plan, schema, first_shard, wside)
+    initargs = (pack_bin_mappers(schema.bin_mappers, config.max_bin),
+                schema.real_feature_index, schema.label_idx, schema.fmt,
+                schema.ncols, schema.weight_idx, schema.group_idx,
+                plan.dtype)
+    gen = _chunks_skipping(sources, config, chunk_bytes,
+                           plan.shard_row0(first_shard))
+    if workers <= 1:
+        _init_worker(*initargs)
+        for chunk in gen:
+            asm.consume(_bin_chunk_task(bytes(chunk)))
+    else:
+        with _make_pool(workers, initargs) as pool:
+            pending: deque = deque()
+            for chunk in gen:
+                pending.append(
+                    pool.apply_async(_bin_chunk_task, (bytes(chunk),)))
+                # bounded in-flight window: Pool.imap would drain the
+                # generator (the whole FILE) into its task queue
+                while len(pending) >= workers + 2:
+                    asm.consume(pending.popleft().get())
+            while pending:
+                asm.consume(pending.popleft().get())
+    asm.finish()
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+_INGEST_FILES = (MANIFEST_NAME, PLAN_NAME, BINS_NAME)
+
+
+def _wipe_ingest_dir(out_dir: str) -> None:
+    """Remove every ingest artifact (stale manifest/plan/shards) ahead
+    of a fresh ingest — partial leftovers must never mix generations."""
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return
+    for name in names:
+        if (name in _INGEST_FILES or name.startswith("shard_")
+                or (name.startswith("rank_r")
+                    and name.endswith(".rows.npz"))):
+            try:
+                os.remove(os.path.join(out_dir, name))
+            except OSError:
+                pass
+
+
+def _manifest_reuse_diff(m: Manifest, cfg_fp: str, src_fp: str,
+                         sources: Sequence[str]) -> str:
+    """Empty string when the existing manifest/plan matches this run,
+    else a human-readable reason naming the moved keys (config drift,
+    source size/mtime drift, a different source list)."""
+    if list(m.sources) != [os.path.abspath(s) for s in sources]:
+        return ("source list: manifest %s vs run %s"
+                % (",".join(m.sources), ",".join(sources)))
+    parts = []
+    if m.config_fp != cfg_fp:
+        parts.append("config drift: "
+                     + fingerprint_diff(m.config_fp, cfg_fp))
+    if m.source_fp != src_fp:
+        parts.append("source drift: "
+                     + fingerprint_diff(m.source_fp, src_fp))
+    return "; ".join(parts)
+
+
+def _valid_shard_prefix(out_dir: str, plan: Manifest) -> int:
+    """Length of the leading run of deep-verified shards (sha256 over
+    every payload byte: resume must not trust externally damaged
+    files).  Files past the prefix are removed."""
+    k = 0
+    while k < plan.num_shards and shard_is_valid(out_dir, plan, k,
+                                                 deep=True):
+        k += 1
+    for i in range(k, plan.num_shards):
+        for name in (shard_name(i), shard_meta_name(i)):
+            try:
+                os.remove(os.path.join(out_dir, name))
+            except OSError:
+                pass
+    return k
+
+
+def _shard_counts(n_total: int, shard_rows: int) -> List[int]:
+    full, tail = divmod(n_total, shard_rows)
+    return [shard_rows] * full + ([tail] if tail else [])
+
+
+def ingest(sources: Sequence[str], out_dir: str, config: Config,
+           find_bins_fn: Optional[FindBinsFn] = None) -> Manifest:
+    """Ingest `sources` into `out_dir` (idempotent + resumable).
+
+    - A COMPLETE matching manifest: reused as-is (fast stat probe).
+    - A manifest/plan whose config or source fingerprint moved: warned
+      with the moved keys, wiped, re-ingested.
+    - A plan with a valid shard prefix (killed ingest): resumed at the
+      first missing shard.
+    """
+    sources = [os.path.abspath(s) for s in sources]
+    for p in sources:
+        if not os.path.isfile(p):
+            log.fatal("Ingest source %s does not exist" % p)
+    if len(sources) > 1:
+        for side in (".weight", ".query", ".init"):
+            if any(os.path.isfile(p + side) for p in sources):
+                log.warning("Ignoring %s sidecars: metadata sidecars "
+                            "are honored for single-file ingests only"
+                            % side)
+    os.makedirs(out_dir, exist_ok=True)
+    cfg_fp = config_fingerprint(config)
+    src_fp = source_fingerprint(sources)
+
+    try:
+        m = load_manifest(out_dir)
+    except ManifestError as ex:
+        log.warning("Ignoring unreadable manifest under %s (%s)"
+                    % (out_dir, ex))
+        _wipe_ingest_dir(out_dir)   # orphaned shards must not mix
+        m = None
+    if m is not None:
+        from ..resilience.atomic import verify_file
+        why = _manifest_reuse_diff(m, cfg_fp, src_fp, sources)
+        if not why and verify_file(
+                os.path.join(out_dir, BINS_NAME)) != "ok":
+            why = "missing/corrupt bins.npz mapper pack"
+        if not why and all(shard_is_valid(out_dir, m, i)
+                           for i in range(m.num_shards)):
+            log.info("Reusing ingest manifest %s (%d shards, %d rows)"
+                     % (out_dir, m.num_shards, m.num_rows))
+            return m
+        log.warning("Re-ingesting %s: %s" % (
+            out_dir, why or "missing/incomplete shard files"))
+        _wipe_ingest_dir(out_dir)
+
+    workers = _auto_workers(config)
+    chunk_bytes = _plan_chunk_bytes(config, workers)
+
+    plan = None
+    try:
+        plan = load_manifest(out_dir, PLAN_NAME)
+    except ManifestError:
+        plan = None
+    first_shard = 0
+    schema: Optional[_Schema] = None
+    if plan is not None:
+        why = _manifest_reuse_diff(plan, cfg_fp, src_fp, sources)
+        if why:
+            log.warning("Ignoring stale ingest plan under %s: %s"
+                        % (out_dir, why))
+            _wipe_ingest_dir(out_dir)
+            plan = None
+        else:
+            schema = _schema_from_plan(out_dir, plan, config)
+            if schema is None:
+                _wipe_ingest_dir(out_dir)
+                plan = None
+            else:
+                first_shard = _valid_shard_prefix(out_dir, plan)
+                log.info("Resuming killed ingest under %s at shard "
+                         "%d/%d" % (out_dir, first_shard,
+                                    plan.num_shards))
+
+    if plan is None:
+        names, fmt, first_line, libsvm_max, kept, n_total = \
+            _sample_pass(sources, config, chunk_bytes)
+        schema = _resolve_schema(names, fmt, first_line, libsvm_max,
+                                 kept, n_total, config, find_bins_fn)
+        del kept
+        shard_rows = _plan_shard_rows(
+            config, len(schema.bin_mappers),
+            np.dtype(schema.dtype).itemsize)
+        qcounts = None
+        if len(sources) == 1:
+            qraw = _load_sidecar(sources[0] + ".query")
+            if qraw is not None:
+                qcounts = qraw.astype(np.int64)
+                if int(qcounts.sum()) != n_total:
+                    log.fatal("Query sizes (%d) do not sum to data "
+                              "count (%d)" % (int(qcounts.sum()),
+                                              n_total))
+            if os.path.isfile(sources[0] + ".init"):
+                log.warning("%s.init: init-score sidecars apply at "
+                            "TRAINING time (they are not baked into "
+                            "the shards)" % sources[0])
+        has_weights = (schema.weight_idx >= 0
+                       or (len(sources) == 1
+                           and os.path.isfile(sources[0] + ".weight")))
+        plan = Manifest(
+            num_rows=n_total, num_features=len(schema.bin_mappers),
+            num_total_features=schema.ncols,
+            label_idx=schema.label_idx, fmt=schema.fmt,
+            dtype=schema.dtype, shard_rows=shard_rows,
+            shard_row_counts=_shard_counts(n_total, shard_rows),
+            feature_names=list(schema.names), has_weights=has_weights,
+            has_query=(qcounts is not None or schema.group_idx >= 0),
+            config_fp=cfg_fp, source_fp=src_fp,
+            sources=list(sources), complete=False)
+        pack = {"packed_mappers": pack_bin_mappers(schema.bin_mappers,
+                                                   config.max_bin),
+                "used_feature_map": schema.used_feature_map,
+                "real_feature_index": schema.real_feature_index,
+                "weight_idx": np.int64(schema.weight_idx),
+                "group_idx": np.int64(schema.group_idx)}
+        if qcounts is not None:
+            pack["qcounts"] = qcounts
+        write_npz(os.path.join(out_dir, BINS_NAME), pack)
+        save_manifest(out_dir, plan, PLAN_NAME)
+
+    _bin_pass(sources, config, schema, plan, out_dir, first_shard,
+              chunk_bytes, workers)
+    plan.complete = True
+    save_manifest(out_dir, plan, MANIFEST_NAME)
+    try:
+        os.remove(os.path.join(out_dir, PLAN_NAME))
+    except OSError:
+        pass
+    log.info("Ingested %d rows x %d features into %s (%d shards, "
+             "%s bins)" % (plan.num_rows, plan.num_features, out_dir,
+                           plan.num_shards, plan.dtype))
+    return plan
+
+
+def _schema_from_plan(out_dir: str, plan: Manifest,
+                      config: Config) -> Optional[_Schema]:
+    """Rebuild the resolved schema of a killed ingest from plan +
+    bins.npz (no sample-pass replay).  None when the pack is missing
+    or corrupt — the caller falls back to a fresh ingest."""
+    from ..resilience.atomic import IntegrityError, read_npz
+    from ..io.binning import unpack_bin_mappers
+    try:
+        with read_npz(os.path.join(out_dir, BINS_NAME)) as z:
+            s = _Schema()
+            s.bin_mappers = unpack_bin_mappers(
+                np.asarray(z["packed_mappers"]))
+            s.used_feature_map = np.asarray(z["used_feature_map"],
+                                            dtype=np.int32)
+            s.real_feature_index = np.asarray(z["real_feature_index"],
+                                              dtype=np.int32)
+            s.weight_idx = int(z["weight_idx"])
+            s.group_idx = int(z["group_idx"])
+    except (OSError, IntegrityError, KeyError, ValueError) as ex:
+        log.warning("Ingest plan under %s has no usable bins.npz "
+                    "(%s); restarting the sample pass" % (out_dir, ex))
+        return None
+    s.names = list(plan.feature_names)
+    s.fmt = plan.fmt
+    s.label_idx = plan.label_idx
+    s.ncols = plan.num_total_features
+    s.n_total = plan.num_rows
+    s.dtype = plan.dtype
+    return s
+
+
+def run_ingest_cli(config: Config) -> None:
+    """task=ingest entry: data=<file>[,<file>...] ingest_dir=<dir>."""
+    sources = source_list(config.data)
+    out = config.ingest_dir or (sources[0] + ".shards")
+    m = ingest(sources, out, config)
+    log.info("Ingest complete: %s (%d rows, %d shards; train with "
+             "data=%s)" % (out, m.num_rows, m.num_shards, out))
+
+
+__all__ = ["ingest", "run_ingest_cli", "source_list", "FindBinsFn"]

@@ -92,11 +92,19 @@ class Dataset:
     def max_num_bin(self) -> int:
         return max((m.num_bin for m in self.bin_mappers), default=1)
 
+    @property
+    def bin_dtype(self) -> np.dtype:
+        """Element dtype of the bin matrix.  A property (not
+        `bins.dtype` at the call sites) so shard-backed datasets
+        (ingest/ShardedDataset) can answer it without materializing
+        the matrix."""
+        return self.bins.dtype
+
     def bin_feature_values(self, feats: np.ndarray) -> np.ndarray:
         """Bin a raw [N, num_total_features] matrix with this dataset's
         mappers -> [F, N]."""
         n = feats.shape[0]
-        dtype = self.bins.dtype
+        dtype = self.bin_dtype
         out = np.zeros((self.num_features, n), dtype=dtype)
         for inner, real in enumerate(self.real_feature_index):
             col = feats[:, real] if real < feats.shape[1] else np.zeros(n)
@@ -248,6 +256,118 @@ def _scan_libsvm_max_idx(chunk: bytes) -> int:
                 except ValueError:
                     pass
     return mx
+
+
+def reservoir_offer(kept: List[bytes], rng: Mt19937Random, target: int,
+                    seen: int, chunk, starts, lens) -> int:
+    """One chunk of the reference's streaming reservoir
+    (TextReader::SampleFromFile, text_reader.h:151-168): the first
+    `target` lines fill the reservoir, line i >= target draws
+    idx = NextInt(0, i+1) on the seeded mt19937 and replaces slot idx
+    when idx < target.  Shared verbatim between `_load_two_round`'s
+    round 1 and the out-of-core ingest sample pass (ingest/writer.py)
+    so both find bit-identical bins.  Returns the updated seen count."""
+    k = len(starts)
+    fill = max(0, min(target - seen, k))
+    for t in range(fill):
+        a = int(starts[t])
+        kept.append(bytes(chunk[a:a + int(lens[t])]))
+    if k > fill:
+        ubs = np.arange(seen + fill + 1, seen + k + 1, dtype=np.int64)
+        idxs = rng.next_ints(ubs)
+        for t in np.flatnonzero(idxs < target):
+            a = int(starts[fill + t])
+            kept[int(idxs[t])] = bytes(chunk[a:a + int(lens[fill + t])])
+    return seen + k
+
+
+@dataclasses.dataclass
+class SampleSchema:
+    """Schema + bin mappers resolved from a reservoir sample — the ONE
+    home of the column rules shared by `_load_two_round` and the
+    out-of-core ingest writer (ingest/writer.py), so the two can never
+    drift (their bins-parity contract depends on identical schema
+    resolution)."""
+    names: List[str]
+    fmt: str
+    label_idx: int
+    ncols: int                     # feature columns (label removed)
+    weight_idx: int                # shifted feature-space index, -1 off
+    group_idx: int
+    used_feature_map: np.ndarray
+    bin_mappers: List["BinMapper"]
+    real_feature_index: np.ndarray
+
+
+def resolve_sample_schema(kept: List[bytes], names: List[str],
+                          fmt: Optional[str], first_line: bytes,
+                          libsvm_max_idx: int, config: Config,
+                          find_bins_hook=None,
+                          what: str = "data") -> SampleSchema:
+    """The reference loader's schema rules over a sampled line set:
+    dense width follows the FIRST data line, libsvm width the
+    whole-file index scan; weight/group columns shift past the label;
+    ignored/trivial columns drop with a warning.  `find_bins_hook`
+    (sample_used_cols [S, U] f64, total_sample_cnt) -> mappers replaces
+    the local per-column FindBin (distributed bin finding)."""
+    label_idx = max(_parse_column_spec(config.label_column, names), 0)
+    sample_raw = b"\n".join(kept) + b"\n"
+    _, sample_feats, fmt = parse_file_bytes(sample_raw, label_idx, fmt)
+    ncols = sample_feats.shape[1]
+    if fmt == "libsvm":
+        # schema width from the whole-file scan, not the sample
+        ncols = max(ncols, libsvm_max_idx + 1)
+    else:
+        # dense width follows the FIRST data line exactly like one-round
+        # loading (native lgt_scan_dense sizes columns from line 1; wider
+        # rows have extra fields ignored, narrower rows zero-fill)
+        _, ffeats, _ = parse_file_bytes(first_line + b"\n", label_idx,
+                                        fmt)
+        ncols = ffeats.shape[1]
+    if sample_feats.shape[1] < ncols:
+        sample_feats = np.pad(
+            sample_feats, ((0, 0), (0, ncols - sample_feats.shape[1])))
+    elif sample_feats.shape[1] > ncols:
+        sample_feats = sample_feats[:, :ncols]
+
+    def shifted(idx):
+        if idx < 0:
+            return -1
+        return idx - 1 if idx > label_idx else idx
+
+    weight_idx = shifted(_parse_column_spec(config.weight_column, names))
+    group_idx = shifted(_parse_column_spec(config.group_column, names))
+    ignore = _parse_ignore_set(config, names)
+    drop_cols = {c for c in (weight_idx, group_idx) if c >= 0}
+    used_cols = [j for j in range(ncols)
+                 if j not in drop_cols and j not in ignore]
+    mappers_all: List[Optional[BinMapper]] = [None] * ncols
+    total = sample_feats.shape[0]
+    if find_bins_hook is not None:
+        for j, m in zip(used_cols,
+                        find_bins_hook(sample_feats[:, used_cols],
+                                       total)):
+            mappers_all[j] = m
+    else:
+        for j in used_cols:
+            mappers_all[j] = find_bin(sample_feats[:, j], total,
+                                      config.max_bin)
+    if not names:
+        names = ["Column_%d" % i for i in range(ncols)]
+    for j in ignore:
+        if 0 <= j < ncols and mappers_all[j] is None:
+            log.warning("Ignoring feature %s" % names[j])
+    used_feature_map, bin_mappers, real_index = _select_used_features(
+        mappers_all, names)
+    if not bin_mappers:
+        log.fatal("No usable features in data file %s" % what)
+    return SampleSchema(names=names, fmt=fmt, label_idx=label_idx,
+                        ncols=ncols, weight_idx=weight_idx,
+                        group_idx=group_idx,
+                        used_feature_map=used_feature_map,
+                        bin_mappers=bin_mappers,
+                        real_feature_index=np.asarray(real_index,
+                                                      dtype=np.int32))
 
 
 def _check_lottery_query_counts(qcounts: np.ndarray, filename: str) -> None:
@@ -403,19 +523,9 @@ def _load_two_round(filename: str, config: Config, rank: int,
                         kept[s] = ln
                 continue
             n_total += k
-            i0 = n_sampled_seen
-            n_sampled_seen += k
-            fill = max(0, min(sample_target - i0, k))
-            for t in range(fill):
-                a = int(starts[t])
-                kept.append(bytes(chunk[a:a + int(lens[t])]))
-            if k > fill:
-                ubs = np.arange(i0 + fill + 1, i0 + k + 1, dtype=np.int64)
-                idxs = res_rng.next_ints(ubs)
-                for t in np.flatnonzero(idxs < sample_target):
-                    a = int(starts[fill + t])
-                    kept[int(idxs[t])] = bytes(
-                        chunk[a:a + int(lens[fill + t])])
+            n_sampled_seen = reservoir_offer(
+                kept, res_rng, sample_target, n_sampled_seen,
+                chunk, starts, lens)
     if n_total == 0:
         log.fatal("Data file %s is empty" % filename)
     keep_mask = None
@@ -441,66 +551,29 @@ def _load_two_round(filename: str, config: Config, rank: int,
             # qid across a dropped one)
             local_heads = np.concatenate(head_chunks)[keep_mask]
 
-    label_idx = _parse_column_spec(config.label_column, names)
-    if label_idx < 0:
-        label_idx = 0
-    sample_raw = b"\n".join(kept) + b"\n"
-    _, sample_feats, fmt = parse_file_bytes(sample_raw, label_idx, fmt)
-    ncols = sample_feats.shape[1]
-    if fmt == "libsvm":
-        # schema width from the whole-file scan, not the sample
-        ncols = max(ncols, libsvm_max_idx + 1)
-    else:
-        # dense width follows the FIRST data line exactly like one-round
-        # loading (native lgt_scan_dense sizes columns from line 1; wider
-        # rows have extra fields ignored, narrower rows zero-fill)
-        _, ffeats, _ = parse_file_bytes(first_line + b"\n", label_idx, fmt)
-        ncols = ffeats.shape[1]
-    if sample_feats.shape[1] < ncols:
-        sample_feats = np.pad(
-            sample_feats, ((0, 0), (0, ncols - sample_feats.shape[1])))
-    elif sample_feats.shape[1] > ncols:
-        sample_feats = sample_feats[:, :ncols]
-
-    def shifted(idx):
-        if idx < 0:
-            return -1
-        return idx - 1 if idx > label_idx else idx
-
-    weight_idx = shifted(_parse_column_spec(config.weight_column, names))
-    group_idx = shifted(_parse_column_spec(config.group_column, names))
-    ignore = _parse_ignore_set(config, names)
-    drop_cols = {c for c in (weight_idx, group_idx) if c >= 0}
-
-    used_cols = [j for j in range(ncols)
-                 if j not in drop_cols and j not in ignore]
-    mappers_all: List[Optional[BinMapper]] = [None] * ncols
+    find_bins_hook = None
     if num_shards > 1 and config.is_parallel_find_bin:
         from .binning import find_bins_distributed
-        dist = find_bins_distributed(sample_feats[:, used_cols],
-                                     sample_feats.shape[0], config.max_bin,
-                                     rank, num_shards)
-        for j, m in zip(used_cols, dist):
-            mappers_all[j] = m
-    else:
-        for j in used_cols:
-            mappers_all[j] = find_bin(sample_feats[:, j],
-                                      sample_feats.shape[0], config.max_bin)
 
-    if not names:
-        names = ["Column_%d" % i for i in range(ncols)]
-
-    for j in ignore:
-        if 0 <= j < ncols and mappers_all[j] is None:
-            log.warning("Ignoring feature %s" % names[j])
-    used_feature_map, bin_mappers, real_index = _select_used_features(
-        mappers_all, names)
-    if not bin_mappers:
-        log.fatal("No usable features in data file %s" % filename)
-    # round-1 artifacts (reservoir lines + parsed sample floats) are tens
-    # of MB at default sample counts — free them so round 2's peak RSS is
-    # one chunk + the uint8 bins, the whole point of two-round loading
-    del kept, sample_raw, sample_feats
+        def find_bins_hook(sample_used, total):
+            return find_bins_distributed(sample_used, total,
+                                         config.max_bin, rank,
+                                         num_shards)
+    schema = resolve_sample_schema(kept, names, fmt, first_line,
+                                   libsvm_max_idx, config,
+                                   find_bins_hook=find_bins_hook,
+                                   what=filename)
+    names, fmt = schema.names, schema.fmt
+    label_idx, ncols = schema.label_idx, schema.ncols
+    weight_idx, group_idx = schema.weight_idx, schema.group_idx
+    used_feature_map = schema.used_feature_map
+    bin_mappers = schema.bin_mappers
+    real_index = schema.real_feature_index
+    # round-1 artifacts (reservoir lines + the helper's parsed sample
+    # floats) are tens of MB at default sample counts — free them so
+    # round 2's peak RSS is one chunk + the uint8 bins, the whole
+    # point of two-round loading
+    del kept, schema
 
     # ---- round 2: parse + quantize chunk by chunk ----
     if not sharding:
@@ -744,41 +817,89 @@ def _save_binary_cache(ds: Dataset, filename: str, config: Config,
     if num_shards > 1 and ds.local_rows is not None:
         # atomic + checksummed (resilience/atomic): a crash mid-write
         # must never leave a truncated sidecar that desyncs the
-        # cluster's row partition on the next run
+        # cluster's row partition on the next run.  Alongside the
+        # lottery identity (seed + granularity) the sidecar records
+        # the SOURCE fingerprint (size/mtime) and the bin-affecting
+        # config fingerprint (ingest/manifest.FP_KEYS) — a cache of a
+        # since-edited file, or one built under different binning
+        # config, must never load silently (_rank_cache_matches)
+        from ..ingest.manifest import (config_fingerprint,
+                                       source_fingerprint)
         write_npz(path + ".rows.npz",
                   dict(rows=ds.local_rows,
                        n_global=np.int64(n_global),
                        seed=np.int64(config.data_random_seed),
                        query_lottery=np.int64(
-                           ds.metadata.query_boundaries is not None)))
+                           ds.metadata.query_boundaries is not None),
+                       config_fp=np.frombuffer(
+                           config_fingerprint(config).encode("utf-8"),
+                           dtype=np.uint8).copy(),
+                       source_fp=np.frombuffer(
+                           source_fingerprint([filename])
+                           .encode("utf-8"), dtype=np.uint8).copy()))
 
 
 def _rank_cache_matches(cache: str, filename: str,
-                        config: Config) -> bool:
-    """True when a rank-tagged cache's `.rows.npz` sidecar records the
-    SAME lottery the current run would draw: data_random_seed and
-    granularity (query vs row — whether a `.query` sidecar drove
-    whole-query draws).  Anything else — a missing sidecar, an older
-    sidecar without these fields, a different seed, a granularity flip —
-    counts as a mismatch: a stale partition must never load silently,
-    because ranks whose caches were deleted would re-lottery under the
-    NEW stream and the cluster's row sets would no longer partition."""
+                        config: Config) -> Optional[str]:
+    """None when a rank-tagged cache's `.rows.npz` sidecar records the
+    SAME dataset this run would build: the lottery identity
+    (data_random_seed + query-vs-row granularity), the SOURCE file's
+    size/mtime, and the bin-affecting config fingerprint
+    (ingest/manifest.FP_KEYS: max_bin, column specs, sample count...).
+    Anything else — a missing sidecar, an older sidecar without these
+    fields, any drifted key — returns a human-readable mismatch reason
+    NAMING the moved keys: a stale partition must never load silently,
+    because ranks whose caches were deleted would re-lottery (or
+    re-bin) under the NEW inputs and the cluster's row sets would no
+    longer partition."""
+    from ..ingest.manifest import (config_fingerprint,
+                                   fingerprint_diff,
+                                   source_fingerprint)
     side = cache + ".rows.npz"
     if not os.path.isfile(side):
-        return False
+        return "no .rows.npz sidecar"
     try:
         with read_npz(side) as z:
-            if "seed" not in z.files or "query_lottery" not in z.files:
-                return False
+            missing = [k for k in ("seed", "query_lottery",
+                                   "config_fp", "source_fp")
+                       if k not in z.files]
+            if missing:
+                return ("sidecar predates fields: %s"
+                        % ", ".join(missing))
             if int(z["seed"]) != int(config.data_random_seed):
-                return False
+                return ("data_random_seed: cache %d vs run %d"
+                        % (int(z["seed"]),
+                           int(config.data_random_seed)))
             want_query = (os.path.isfile(filename + ".query")
                           or bool(config.group_column.strip()))
-            return bool(int(z["query_lottery"])) == want_query
-    except Exception:
+            if bool(int(z["query_lottery"])) != want_query:
+                return ("lottery granularity: cache %s vs run %s"
+                        % ("query" if int(z["query_lottery"])
+                           else "row",
+                           "query" if want_query else "row"))
+            cache_cfg = bytes(np.asarray(z["config_fp"]).tobytes()) \
+                .decode("utf-8", "replace")
+            run_cfg = config_fingerprint(config)
+            if cache_cfg != run_cfg:
+                return ("config drift: "
+                        + fingerprint_diff(cache_cfg, run_cfg)
+                        .replace("manifest", "cache"))
+            if os.path.isfile(filename):
+                cache_src = bytes(np.asarray(z["source_fp"])
+                                  .tobytes()).decode("utf-8", "replace")
+                run_src = source_fingerprint([filename])
+                if cache_src != run_src:
+                    return ("source drift: "
+                            + fingerprint_diff(cache_src, run_src)
+                            .replace("manifest", "cache"))
+            # a DELETED source does not invalidate the cache: the
+            # binary cache is a standalone artifact (the reference
+            # loads `.bin` without the text too)
+            return None
+    except Exception as ex:
         # any unreadable sidecar (truncated write from a killed run
         # raises zipfile.BadZipFile, not OSError) = mismatch
-        return False
+        return "unreadable sidecar (%s)" % ex
 
 
 def load_dataset(filename: str, config: Config,
@@ -799,22 +920,50 @@ def load_dataset(filename: str, config: Config,
     a single machine) loads with the reference's lottery subsample
     applied per rank (dataset_loader.cpp:343-375).
     """
+    from ..ingest.manifest import is_manifest_path
+    if is_manifest_path(filename):
+        # out-of-core ingest directory (ingest/): mmap-backed shards,
+        # never the whole matrix on the host.  tree_learner=data ranks
+        # take their manifest slice via the same seeded row lottery
+        # the text paths replay.
+        from ..ingest.shards import load_sharded_dataset
+        ds = load_sharded_dataset(filename, config, rank=rank,
+                                  num_shards=num_shards)
+        if reference is not None:
+            # valid data from shards: legal only when its bins were
+            # found under the SAME mappers as the train set's (valid
+            # sets must bin with the train mappers,
+            # Dataset::CopyFeatureMapperFrom)
+            from .binning import pack_bin_mappers
+            mb = max(reference.max_num_bin, ds.max_num_bin)
+            same = (len(reference.bin_mappers) == len(ds.bin_mappers)
+                    and np.array_equal(
+                        pack_bin_mappers(reference.bin_mappers, mb),
+                        pack_bin_mappers(ds.bin_mappers, mb)))
+            if not same:
+                log.fatal(
+                    "Ingest directory %s was binned with different "
+                    "mappers than the training data; re-ingest the "
+                    "validation file against the same config"
+                    % filename)
+        return ds
+
     cache = _rank_cache_path(filename, rank, num_shards)
     global_cache = filename + ".bin"
     shard_from_global = False
     if (reference is None and config.enable_load_from_binary_file
             and num_shards > 1 and cache != global_cache
-            and os.path.isfile(cache)
-            and not _rank_cache_matches(cache, filename, config)):
-        # stale rank-tagged cache: its recorded lottery (seed /
-        # granularity) differs from the one this run would draw —
-        # ignore it and fall back to the global cache or text
-        log.warning(
-            "Ignoring rank-tagged binary cache %s: its lottery "
-            "(data_random_seed / query granularity) does not match the "
-            "current config" % cache)
-        cache = global_cache
-        shard_from_global = not config.is_pre_partition
+            and os.path.isfile(cache)):
+        why = _rank_cache_matches(cache, filename, config)
+        if why is not None:
+            # stale rank-tagged cache: its recorded lottery / source /
+            # config fingerprint differs from this run's — ignore it
+            # and fall back to the global cache or text, NAMING the
+            # moved keys (the snapshot resume_fp convention)
+            log.warning("Ignoring rank-tagged binary cache %s: %s"
+                        % (cache, why))
+            cache = global_cache
+            shard_from_global = not config.is_pre_partition
     if (reference is None and config.enable_load_from_binary_file
             and not os.path.isfile(cache) and num_shards > 1
             and os.path.isfile(global_cache)):
@@ -1013,7 +1162,8 @@ def load_dataset(filename: str, config: Config,
 
     if reference is not None:
         ds = Dataset(
-            bins=np.zeros((reference.num_features, n), dtype=reference.bins.dtype),
+            bins=np.zeros((reference.num_features, n),
+                          dtype=reference.bin_dtype),
             bin_mappers=reference.bin_mappers,
             used_feature_map=reference.used_feature_map,
             real_feature_index=reference.real_feature_index,

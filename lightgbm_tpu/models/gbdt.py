@@ -815,7 +815,7 @@ class GBDT:
             on_accel = jax.devices()[0].platform != "cpu"
             impl = ("pallas" if (on_accel and self.max_bin <= 256
                                  and self.dtype == jnp.float32
-                                 and train_data.bins.dtype == np.uint8)
+                                 and train_data.bin_dtype == np.uint8)
                     else "xla")
             if on_accel and impl == "xla":
                 # not silent: the parity configuration (hist_dtype=
@@ -825,7 +825,7 @@ class GBDT:
                     "accelerator (max_bin=%d, hist_dtype=%s, bins dtype "
                     "%s); using the slower XLA one-hot path"
                     % (self.max_bin, config.hist_dtype,
-                       train_data.bins.dtype))
+                       train_data.bin_dtype))
         self.hist_impl = impl
         row_unit = 1
         if impl == "pallas":
@@ -837,7 +837,7 @@ class GBDT:
             if self.dtype != jnp.float32:
                 log.fatal("hist_impl=pallas accumulates in float32; "
                           "hist_dtype=%s is incompatible" % config.hist_dtype)
-            if train_data.bins.dtype != np.uint8:
+            if train_data.bin_dtype != np.uint8:
                 log.fatal("hist_impl=pallas requires uint8 bins")
             row_unit = PALLAS_ROW_BLOCK
 
@@ -997,7 +997,18 @@ class GBDT:
         self._gstate_override = None
         self._trees_since_reorder = 0
 
-        bins = train_data.bins
+        # out-of-core ingest (ingest/ShardedDataset): feed the device
+        # one shard window at a time — the full [F, N] matrix never
+        # exists on the host.  The query-granular layout still needs a
+        # host scatter (place()), so it takes the materializing
+        # fallback (ShardedDataset.bins logs it); so does the FEATURE-
+        # sharded learner, whose grower splits F (every rank holds all
+        # rows by that learner's premise — out-of-core row feeding
+        # cannot help it).
+        streamed = (getattr(train_data, "is_shard_backed", False)
+                    and self._shard_layout is None
+                    and (self.grower is None or self.rows_sharded))
+        bins = None if streamed else train_data.bins
         self.scores = self._init_scores(train_data, n)
         if self._shard_layout is not None:
             # query-granular layout: file rows scatter into per-shard
@@ -1007,11 +1018,14 @@ class GBDT:
             self.scores = jnp.asarray(
                 self._shard_layout.place(np.asarray(self.scores)))
         elif self.n_pad != n:
-            bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
+            if bins is not None:
+                bins = np.pad(bins, ((0, 0), (0, self.n_pad - n)))
             self.scores = jnp.pad(self.scores,
                                   ((0, 0), (0, self.n_pad - n)))
         if self.grower is not None:
-            self.bins_dev = self.grower.shard_bins(bins)
+            self.bins_dev = (self._put_bins_sharded_streamed(train_data)
+                             if streamed
+                             else self.grower.shard_bins(bins))
             if self.rows_sharded and not self._mh:
                 # single-host: shard scores so the leaf_id gather-add
                 # stays on-device
@@ -1025,7 +1039,8 @@ class GBDT:
                 self.scores = self.grower.shard_rows(
                     np.asarray(self.scores), self.n_pad)
         else:
-            self.bins_dev = jnp.asarray(bins)
+            self.bins_dev = (self._put_bins_streamed(train_data)
+                             if streamed else jnp.asarray(bins))
         if objective is not None and self.n_pad != n:
             objective.pad_to(self.n_pad)
 
@@ -1101,6 +1116,64 @@ class GBDT:
                 return jnp.asarray(init.reshape(k, n))
             log.warning("init score size mismatch, ignoring")
         return jnp.zeros((k, n), dtype=jnp.float32)
+
+    def _put_bins_streamed(self, ds) -> jax.Array:
+        """Device bins assembled one shard window at a time (out-of-core
+        ingest): each [F, k] window device_puts independently and the
+        concatenation happens ON DEVICE, so peak host memory is one
+        window — the full matrix exists only in device memory, where
+        training needs it anyway."""
+        parts = [jax.device_put(np.ascontiguousarray(w))
+                 for w in ds.iter_bin_windows()]
+        pad = self.n_pad - ds.num_data
+        if pad > 0:
+            parts.append(jnp.zeros((ds.num_features, pad),
+                                   dtype=ds.bin_dtype))
+        if len(parts) == 1:
+            return parts[0]
+        return jnp.concatenate(parts, axis=1)
+
+    def _put_bins_sharded_streamed(self, ds) -> jax.Array:
+        """Shard-window feeding for the data/voting-parallel growers.
+        Multi-host: the global array assembles from this process's
+        LOCAL block — the rank's manifest slice, 1/R of the data —
+        which is the out-of-core scaling contract (each host pays for
+        its slice, never the file).  Single-host: each mesh device's
+        row block assembles on the host (peak: ONE block + one
+        window) and device_puts straight to ITS device — no device
+        ever stages the full matrix, so per-chip HBM holds 1/S of the
+        data exactly like the host path's sharded placement."""
+        if self._mh:
+            local = ds.local_bins_matrix()
+            if local.shape[1] < self.n_pad:
+                local = np.pad(
+                    local, ((0, 0), (0, self.n_pad - local.shape[1])))
+            return self.grower.shard_bins(local)
+        sharding = self.grower.bins_sharding()
+        devs = list(self.grower.mesh.devices.flat)
+        block = self.n_pad // len(devs)   # n_pad is row_unit*S-aligned
+        f = ds.num_features
+        cur = np.zeros((f, block), dtype=ds.bin_dtype)
+        pieces = []
+        fill = 0
+        for w in ds.iter_bin_windows():
+            o = 0
+            k = w.shape[1]
+            while o < k:
+                take = min(block - fill, k - o)
+                cur[:, fill:fill + take] = w[:, o:o + take]
+                fill += take
+                o += take
+                if fill == block:
+                    pieces.append(jax.device_put(cur,
+                                                 devs[len(pieces)]))
+                    cur = np.zeros((f, block), dtype=ds.bin_dtype)
+                    fill = 0
+        while len(pieces) < len(devs):   # trailing pad blocks (zeros)
+            pieces.append(jax.device_put(cur, devs[len(pieces)]))
+            cur = np.zeros((f, block), dtype=ds.bin_dtype)
+        return jax.make_array_from_single_device_arrays(
+            (f, self.n_pad), sharding, pieces)
 
     def add_valid_data(self, data: Dataset, metrics: Sequence[Metric]) -> None:
         if self.iter > 0:

@@ -19,6 +19,7 @@ exercised every seam):
     serve.dispatch      the serving forest's device dispatch
     reload.parse        /reload, before parsing the new model
     frontend.spawn      each front-end worker (re)spawn attempt
+    ingest.shard_write  out-of-core ingest, before each shard commit
 
 Schedule spec (config key `faults=...` or env LGBM_TPU_FAULTS;
 ';'-separated entries):
@@ -54,6 +55,7 @@ KNOWN_FAULTPOINTS: Tuple[str, ...] = (
     "checkpoint.write", "checkpoint.commit", "flush.device_get",
     "dist.connect", "dist.send", "dist.recv",
     "serve.dispatch", "reload.parse", "frontend.spawn",
+    "ingest.shard_write",
 )
 
 ENV_VAR = "LGBM_TPU_FAULTS"

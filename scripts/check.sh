@@ -19,6 +19,9 @@
 #      reload byte parity, then the multi-process front-end leg —
 #      4 SO_REUSEPORT workers, SIGKILL-under-load respawn, per-worker
 #      liveness on /metrics
+#   5. scripts/ingest_smoke.sh (when jax imports): out-of-core ingest
+#      SIGKILL + resume byte identity, shard-fed vs text training and
+#      predict byte parity
 #
 # Exit codes:
 #   0  everything that ran is clean
@@ -65,8 +68,12 @@ if python -c "import jax" 2>/dev/null; then
     bash scripts/serve_smoke.sh
     s=$?
     [ "$s" -ne 0 ] && rc=1
+    echo "== ingest smoke (kill-resume byte identity + shard-fed train parity) =="
+    bash scripts/ingest_smoke.sh
+    g=$?
+    [ "$g" -ne 0 ] && rc=1
 else
-    echo "== jax not importable — chaos_smoke + serve_smoke SKIPPED (jax-free lane) =="
+    echo "== jax not importable — chaos_smoke + serve_smoke + ingest_smoke SKIPPED (jax-free lane) =="
 fi
 
 if [ "$rc" -eq 0 ]; then

@@ -67,6 +67,15 @@ def main() -> int:
     ap.add_argument("--mb", type=float, default=0)
     ap.add_argument("--gb", type=float, default=0)
     ap.add_argument("--one-round", action="store_true")
+    ap.add_argument("--shards", default="",
+                    help="out-of-core mode: ingest into this shard "
+                         "directory (lightgbm_tpu/ingest) instead of "
+                         "loading an in-memory Dataset")
+    ap.add_argument("--budget-mb", type=int, default=0,
+                    help="ingest_memory_budget_mb for --shards")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="ingest_workers for --shards (1 = inline, "
+                         "so --trace-peak sees every allocation)")
     ap.add_argument("--trace-peak", action="store_true",
                     help="tracemalloc the load and report peak_py_mb: the "
                          "loader's OWN allocation high-water (numpy buffers "
@@ -84,24 +93,36 @@ def main() -> int:
     import_rss = max(import_rss,
                      resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
 
-    cfg = Config.from_params({
+    params = {
         "is_save_binary_file": "false",
-        "use_two_round_loading": "false" if args.one_round else "true"})
+        "use_two_round_loading": "false" if args.one_round else "true"}
+    if args.shards:
+        params["ingest_workers"] = str(args.workers)
+        if args.budget_mb:
+            params["ingest_memory_budget_mb"] = str(args.budget_mb)
+    cfg = Config.from_params(params)
     if args.trace_peak:
         import tracemalloc
         tracemalloc.start()
     t0 = time.time()
-    ds = load_dataset(path, cfg)
+    if args.shards:
+        from lightgbm_tpu.ingest.writer import ingest
+        rows = ingest([path], args.shards, cfg).num_rows
+        mode = "ingest_shards"
+    else:
+        rows = load_dataset(path, cfg).num_data
+        mode = "one_round" if args.one_round else "two_round"
     wall = time.time() - t0
     size = os.path.getsize(path)
     rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    rss = max(rss, resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss)
     rec = {
-        "bytes": size, "rows": ds.num_data,
+        "bytes": size, "rows": rows,
         "wall_s": round(wall, 2),
         "mb_per_s": round(size / (1 << 20) / wall, 2),
         "max_rss_mb": round(rss / 1024, 1),
         "import_rss_mb": round(import_rss / 1024, 1),
-        "mode": "one_round" if args.one_round else "two_round",
+        "mode": mode,
     }
     if args.trace_peak:
         _, peak = tracemalloc.get_traced_memory()

@@ -1,0 +1,119 @@
+#!/usr/bin/env bash
+# scripts/ingest_smoke.sh — fast out-of-core ingest round trip:
+#
+#   1. SIGKILL a CLI ingest at the ingest.shard_write seam (fault
+#      injection), resume it, and byte-compare every shard + the
+#      manifest against an uninterrupted ingest
+#   2. train from the shard directory and from the text file —
+#      model bytes must be IDENTICAL
+#   3. task=predict with both models — output bytes must be IDENTICAL
+#
+# Nonzero exit on any mismatch.  The slow-marked cousins
+# (tests/test_ingest_scale.py, tests/test_chaos.py) prove the same
+# properties at scale; this is the pre-merge smoke.
+
+set -u
+cd "$(dirname "$0")/.."
+
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS=cpu
+unset LGBM_TPU_FAULTS 2>/dev/null || true
+
+PY=python
+DATA="$TMP/train.tsv"
+$PY - "$DATA" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.RandomState(3)
+n = 400
+x = rng.randn(n, 6)
+y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(int)
+with open(sys.argv[1], "w") as f:
+    for i in range(n):
+        f.write("%d\t" % y[i] + "\t".join("%.6g" % v for v in x[i]) + "\n")
+EOF
+
+INGEST_ARGS="task=ingest data=$DATA ingest_workers=1 ingest_shard_rows=64"
+
+echo "== ingest_smoke: clean ingest =="
+$PY -m lightgbm_tpu $INGEST_ARGS "ingest_dir=$TMP/clean" \
+    > "$TMP/log_clean.txt" 2>&1 || {
+    echo "ingest_smoke: clean ingest FAILED" >&2
+    cat "$TMP/log_clean.txt" >&2
+    exit 1
+}
+
+echo "== ingest_smoke: SIGKILL at shard 3, then resume =="
+LGBM_TPU_FAULTS="ingest.shard_write@3=kill" \
+    $PY -m lightgbm_tpu $INGEST_ARGS "ingest_dir=$TMP/killed" \
+    > "$TMP/log_kill.txt" 2>&1
+rc=$?
+if [ "$rc" -ne 137 ] && [ "$rc" -ne 265 ]; then
+    echo "ingest_smoke: expected SIGKILL (137), got rc=$rc" >&2
+    cat "$TMP/log_kill.txt" >&2
+    exit 1
+fi
+if [ -f "$TMP/killed/manifest.json" ]; then
+    echo "ingest_smoke: killed ingest left a COMMITTED manifest" >&2
+    exit 1
+fi
+$PY -m lightgbm_tpu $INGEST_ARGS "ingest_dir=$TMP/killed" \
+    > "$TMP/log_resume.txt" 2>&1 || {
+    echo "ingest_smoke: resume FAILED" >&2
+    cat "$TMP/log_resume.txt" >&2
+    exit 1
+}
+grep -q "Resuming killed ingest" "$TMP/log_resume.txt" || {
+    echo "ingest_smoke: resume did not take the resume path" >&2
+    cat "$TMP/log_resume.txt" >&2
+    exit 1
+}
+for f in "$TMP/clean"/shard_* "$TMP/clean/manifest.json"; do
+    b="$TMP/killed/$(basename "$f")"
+    cmp -s "$f" "$b" || {
+        echo "ingest_smoke: $(basename "$f") differs after resume" >&2
+        exit 1
+    }
+done
+
+echo "== ingest_smoke: shard-fed vs text training byte parity =="
+TRAIN_ARGS="task=train num_iterations=6 num_leaves=7 min_data_in_leaf=5 \
+ min_sum_hessian_in_leaf=1 metric= bagging_fraction=0.8 bagging_freq=2 \
+ feature_fraction=0.9 is_save_binary_file=false"
+$PY -m lightgbm_tpu $TRAIN_ARGS "data=$DATA" \
+    "output_model=$TMP/model_text.txt" > "$TMP/log_t1.txt" 2>&1 || {
+    echo "ingest_smoke: text-path training FAILED" >&2
+    cat "$TMP/log_t1.txt" >&2
+    exit 1
+}
+$PY -m lightgbm_tpu $TRAIN_ARGS "data=$TMP/killed" \
+    "output_model=$TMP/model_shards.txt" > "$TMP/log_t2.txt" 2>&1 || {
+    echo "ingest_smoke: shard-fed training FAILED" >&2
+    cat "$TMP/log_t2.txt" >&2
+    exit 1
+}
+cmp -s "$TMP/model_text.txt" "$TMP/model_shards.txt" || {
+    echo "ingest_smoke: shard-fed model differs from text-path model" >&2
+    diff <(head -5 "$TMP/model_text.txt") \
+         <(head -5 "$TMP/model_shards.txt") >&2 || true
+    exit 1
+}
+
+echo "== ingest_smoke: predict byte parity =="
+for m in model_text model_shards; do
+    $PY -m lightgbm_tpu task=predict "data=$DATA" \
+        "input_model=$TMP/$m.txt" "output_result=$TMP/$m.pred" \
+        > "$TMP/log_p_$m.txt" 2>&1 || {
+        echo "ingest_smoke: predict with $m FAILED" >&2
+        cat "$TMP/log_p_$m.txt" >&2
+        exit 1
+    }
+done
+cmp -s "$TMP/model_text.pred" "$TMP/model_shards.pred" || {
+    echo "ingest_smoke: predictions differ between models" >&2
+    exit 1
+}
+
+echo "ingest_smoke: PASS (kill-resume byte identity, shard-fed train parity, predict parity)"
+exit 0

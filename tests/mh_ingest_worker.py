@@ -1,0 +1,62 @@
+"""Worker for the 2-process shard-fed multi-host test
+(test_ingest.py::test_multihost_shard_fed_two_process).
+
+Usage: python mh_ingest_worker.py <rank> <nproc> <port> <ingest_dir>
+       <model_out>
+
+Each worker owns 4 virtual CPU devices (8 global), joins the jax
+distributed runtime, loads ITS manifest slice of the shard directory
+(the seeded row lottery over the manifest's global row order — no
+text parse, no whole-file read), trains tree_learner=data over the
+global mesh and saves the model.  The test asserts both ranks save
+identical bytes and the tree structure matches a single-process
+8-shard run fed from the SAME manifest."""
+
+import os
+import sys
+
+rank, nproc, port, data, out = (int(sys.argv[1]), int(sys.argv[2]),
+                                sys.argv[3], sys.argv[4], sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import load_dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "data", "num_leaves": "8",
+    "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+    "hist_dtype": "float64", "metric": "", "is_save_binary_file": "false"})
+ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+assert getattr(ds, "is_shard_backed", False), \
+    "manifest path must load a ShardedDataset"
+obj = create_objective(cfg)
+obj.init(ds.metadata, ds.num_data)
+booster = create_boosting(cfg, ds, obj)
+assert booster._mh_fused and booster._can_fuse(), \
+    "multi-host shard-fed data-parallel must take the fused path"
+for _ in range(3):
+    booster.train_one_iter(None, None, False)
+# the out-of-core contract: training never asked for the materialized
+# host matrix (the local block device-feeds from shard windows)
+assert not ds._warned_materialize, \
+    "shard-fed mh training materialized Dataset.bins on the host"
+booster.save_model_to_file(-1, True, out)
+print("worker %d done: %d trees over %d local rows"
+      % (rank, len(booster.models), ds.num_data))

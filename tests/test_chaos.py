@@ -424,6 +424,40 @@ def test_api_params_arm_fault_schedule(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# out-of-core ingest: SIGKILL mid-ingest, resume to byte-identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ingest_sigkill_resume_byte_identical(tmp_path):
+    """SIGKILL the CLI ingest at the ingest.shard_write seam; the
+    resumed run completes a shard directory whose shard payloads,
+    metas and manifest are byte-identical to an uninterrupted
+    ingest's."""
+    data = _write_data(tmp_path, "binary")
+    args = ["task=ingest", "data=" + data, "ingest_workers=1",
+            "ingest_shard_rows=64", "ingest_memory_budget_mb=64"]
+    clean = str(tmp_path / "clean")
+    _run_cli(args + ["ingest_dir=" + clean])
+    killed = str(tmp_path / "killed")
+    out = _run_cli(args + ["ingest_dir=" + killed],
+                   faults_spec="ingest.shard_write@3=kill", check=False)
+    assert out[0] in SIGKILLED, out
+    assert not os.path.exists(os.path.join(killed, "manifest.json"))
+    out2 = _run_cli(args + ["ingest_dir=" + killed])
+    assert "Resuming killed ingest" in out2[1]
+    names = sorted(n for n in os.listdir(clean)
+                   if n.startswith("shard_") or n == "manifest.json")
+    assert names == sorted(n for n in os.listdir(killed)
+                           if n.startswith("shard_")
+                           or n == "manifest.json")
+    assert len([n for n in names if n.endswith(".bins")]) >= 5
+    for n in names:
+        with open(os.path.join(clean, n), "rb") as fa, \
+                open(os.path.join(killed, n), "rb") as fb:
+            assert fa.read() == fb.read(), n
+
+
+# ---------------------------------------------------------------------------
 # every faultpoint is reachable through its REAL seam (tier-1)
 # ---------------------------------------------------------------------------
 
@@ -490,6 +524,13 @@ def test_every_faultpoint_reachable(tmp_path):
         assert len(fe.worker_pids()) == 2
     finally:
         fe.shutdown(drain_timeout=20.0)
+
+    # ingest.shard_write: a real (tiny) out-of-core ingest
+    from lightgbm_tpu.ingest.writer import ingest as run_ingest
+    ing_src = _write_data(tmp_path, "binary")
+    run_ingest([ing_src], str(tmp_path / "ingest_shards"),
+               Config.from_params({"ingest_workers": "1",
+                                   "ingest_shard_rows": "128"}))
 
     missing = [n for n in faults.KNOWN_FAULTPOINTS
                if faults.hits(n) == 0]

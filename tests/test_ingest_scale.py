@@ -13,13 +13,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "ingest_bench.py")
 
 
-def _run(mode_args):
+def _run(mode_args, mb="150"):
     # strip the suite's 8-virtual-device XLA_FLAGS: it balloons the
     # subprocess's import footprint for no reason (ingest is host-only)
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
-        [sys.executable, SCRIPT, "--mb", "150", "--trace-peak", *mode_args],
+        [sys.executable, SCRIPT, "--mb", mb, "--trace-peak", *mode_args],
         capture_output=True, text=True, timeout=1200, env=env)
     assert out.returncode == 0, out.stdout + out.stderr
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -49,3 +49,69 @@ def test_two_round_rss_bounded_vs_one_round():
     # the one-round path DOES materialize the file (raw text + f64s)
     assert one["peak_py_mb"] > 400, (one, two)
     assert two["peak_py_mb"] < 0.3 * one["peak_py_mb"], (one, two)
+
+
+@pytest.mark.slow
+def test_out_of_core_ingest_respects_memory_budget(tmp_path):
+    """THE memory-budget proof (ISSUE 10 acceptance): ingest a file
+    >2x `ingest_memory_budget_mb` into shards and hold the loader's
+    own allocation peak (tracemalloc: numpy buffers register their
+    bytes) UNDER the budget, and the process RSS growth over the
+    import baseline (resource.getrusage, the OS-level check) under
+    budget + slack.  The two-round in-memory loader cannot pass this
+    bar — its [F, N] bin matrix alone (~33 MB here) plus the 50k-line
+    reservoir is bounded by the FILE, not the budget; the shard writer
+    is bounded by chunk + shard buffer + reservoir regardless of file
+    size."""
+    budget = 96
+    rec = _run(["--shards", str(tmp_path / "shards"),
+                "--budget-mb", str(budget), "--workers", "1"],
+               mb="224")
+    assert rec["bytes"] > 2 * budget * (1 << 20), rec
+    assert rec["rows"] > 800_000, rec
+    # structural bound: the writer's own allocations stay under budget
+    assert rec["peak_py_mb"] < budget, rec
+    # OS-level bound, import baseline subtracted (allocator arenas and
+    # import-cache state make absolute RSS flaky under full-suite load
+    # — VERDICT r2/r3; the GROWTH is the loader's doing), generous
+    # slack for arena rounding
+    assert rec["max_rss_mb"] - rec["import_rss_mb"] < budget + 64, rec
+
+
+@pytest.mark.slow
+def test_ingest_resume_skips_committed_work(tmp_path):
+    """Killed-at-scale resume: killing after a few shards and
+    resuming produces byte-identical shard files, and the resume run's
+    skip scan is cheap (no re-bin of the committed prefix — asserted
+    via the resume log line)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    clean = str(tmp_path / "clean")
+    killed = str(tmp_path / "killed")
+    # tight 8 MB budget => ~74k-row shards, so the 96 MB file spans
+    # several shards and the @3 kill lands mid-ingest
+    base = [sys.executable, SCRIPT, "--mb", "96", "--budget-mb", "8"]
+    out = subprocess.run(base + ["--shards", clean],
+                         capture_output=True, text=True, timeout=1200,
+                         env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    env_kill = dict(env, LGBM_TPU_FAULTS="ingest.shard_write@3=kill")
+    out = subprocess.run(base + ["--shards", killed],
+                         capture_output=True, text=True, timeout=1200,
+                         env=env_kill)
+    assert out.returncode in (-9, 137), (out.returncode, out.stdout)
+    out = subprocess.run(base + ["--shards", killed],
+                         capture_output=True, text=True, timeout=1200,
+                         env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Resuming killed ingest" in out.stdout
+    names = sorted(n for n in os.listdir(clean)
+                   if n.startswith("shard_") or n == "manifest.json")
+    assert names == sorted(n for n in os.listdir(killed)
+                           if n.startswith("shard_")
+                           or n == "manifest.json")
+    for n in names:
+        with open(os.path.join(clean, n), "rb") as fa, \
+                open(os.path.join(killed, n), "rb") as fb:
+            assert fa.read() == fb.read(), n
