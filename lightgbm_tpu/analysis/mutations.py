@@ -168,6 +168,14 @@ def _t_remove_grow_oracle(src: str) -> str:
                              what="parity_oracle removal from grow_tree")
 
 
+def _t_remove_split_oracle(src: str) -> str:
+    # the SPLIT-path oracle (round 16): hist_fused=off is only an
+    # oracle while find_best_split stays pinned in the registry
+    return _remove_decorator(
+        src, "@contract.parity_oracle(",
+        what="parity_oracle removal from find_best_split")
+
+
 def _t_np_random_in_pack_tree(src: str) -> str:
     return _insert_after(
         src, "def _pack_tree(dev_tree):\n",
@@ -236,6 +244,20 @@ def _t_rogue_device_get(src: str) -> str:
         "stacks\n",
         "        _probe = jax.device_get(scores)  # seeded violation\n",
         what="rogue jax.device_get into _run_fused_multi")
+
+
+def _t_host_sync_in_prefetch_handoff(src: str) -> str:
+    # an end-of-load device_get barrier planted right after the shard
+    # windows drain: it stalls the load on every in-flight transfer
+    # (defeating the async device_put pipelining the prefetch feed
+    # builds) and round-trips the whole bin matrix back to the host —
+    # all outside the sanctioned flush accounting
+    return _insert_after(
+        src,
+        "        pad = self.n_pad - ds.num_data\n",
+        "        parts = [jax.device_get(p) for p in parts]"
+        "  # seeded violation\n",
+        what="host sync into the _put_bins_streamed prefetch handoff")
 
 
 def _t_remove_counted_flush(src: str) -> str:
@@ -324,6 +346,13 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "removing grow_tree's parity_oracle annotation — the oracle SET "
        "is pinned by EXPECTED_PARITY_ORACLES",
        _t_remove_grow_oracle),
+    _m("split-oracle-annotation-removed", "parity_oracle",
+       "ops/split.py", "GC003", "ops/split.py",
+       "missing its @contract.parity_oracle",
+       "removing find_best_split's parity_oracle annotation — "
+       "hist_fused=off is the fused kernel's bit-parity oracle only "
+       "while the split path stays pinned",
+       _t_remove_split_oracle),
     _m("np-random-in-pack-tree", "parity_oracle", "models/gbdt.py",
        "GC003", "models/gbdt.py", "np.random",
        "np.random inside _pack_tree — reachable from the general-path "
@@ -368,6 +397,14 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "removing the counted_flush annotation — the flush's own "
        "device_get immediately loses its sanction",
        _t_remove_counted_flush),
+    _m("host-sync-in-prefetch-handoff", "counted_flush",
+       "models/gbdt.py", "GC006", "models/gbdt.py",
+       "GBDT._put_bins_streamed",
+       "a jax.device_get barrier planted at the end of the shard-"
+       "window prefetch handoff — it stalls the load on every "
+       "in-flight transfer, round-trips the bin matrix to the host, "
+       "and dodges the flush accounting",
+       _t_host_sync_in_prefetch_handoff),
 
     _m("bare-checkpoint-write", "durable_write", "models/gbdt.py",
        "GC008", "models/gbdt.py", "open(.., 'wb')",

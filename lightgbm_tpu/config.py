@@ -196,6 +196,36 @@ class Config:
     #                                       leaves every hist_reorder_every
     #                                       trees (serial pallas learner)
     hist_reorder_every: int = 16          # trees between row re-sorts
+    hist_fused: str = "auto"              # auto | on | off: fused Pallas
+    #                                       histogram+gain kernel — the
+    #                                       per-split children sweep runs
+    #                                       the best-split threshold scan
+    #                                       in-register on the VMEM-
+    #                                       resident accumulators instead
+    #                                       of a separate XLA pass over
+    #                                       the [F, B, 3] tensor.  auto
+    #                                       engages with hist_impl=pallas
+    #                                       (serial learner; other
+    #                                       learners keep the two-op
+    #                                       path); off IS the retained
+    #                                       two-op oracle — fused on is
+    #                                       bit-parity with it (the
+    #                                       kernel runs the oracle's
+    #                                       exact scan ops)
+    hist_acc: str = "f32"                 # f32 | bf16 | i32: Pallas
+    #                                       histogram accumulator mode.
+    #                                       f32 is the parity default;
+    #                                       bf16 streams gh2/one-hots in
+    #                                       bfloat16 (half the VMEM and
+    #                                       gh2 bandwidth, f32 MXU
+    #                                       accumulate); i32 accumulates
+    #                                       overflow-safe fixed-point
+    #                                       integers (order-independent,
+    #                                       exact counts).  bf16/i32
+    #                                       round the inputs, so both are
+    #                                       OPT-IN behind the f32 parity
+    #                                       gate (serial pallas learner
+    #                                       only)
     bag_compact: str = "auto"             # auto | on | off: bag-compacted fused
     #                                       training — in-bag rows arranged into
     #                                       a contiguous static window at every
@@ -280,6 +310,21 @@ class Config:
     ingest_workers: int = 0               # parallel parse worker
     #                                       processes (0 = auto, 1 =
     #                                       inline single-process)
+    ingest_prefetch: int = 2              # shard windows staged ahead by
+    #                                       the background prefetch
+    #                                       thread when training feeds
+    #                                       from an ingest directory:
+    #                                       the NEXT window pages in from
+    #                                       disk while the previous
+    #                                       device_put's transfer is in
+    #                                       flight (bounded queue; host
+    #                                       memory holds at most
+    #                                       2 + ingest_prefetch windows —
+    #                                       queued + producer-staged +
+    #                                       consumer-held).
+    #                                       0 = synchronous (the oracle:
+    #                                       byte-identical models either
+    #                                       way)
 
     # -- fault tolerance (resilience/) -----------------------------------
     snapshot_period: int = 0              # snapshot every N iterations
@@ -445,6 +490,8 @@ class Config:
         set_str("hist_compact")
         set_str("hist_ordered")
         set_int("hist_reorder_every")
+        set_str("hist_fused")
+        set_str("hist_acc")
         set_str("bag_compact")
         set_str("iter_batch")
         set_bool("donate_buffers")
@@ -466,6 +513,7 @@ class Config:
         set_int("ingest_memory_budget_mb")
         set_int("ingest_shard_rows")
         set_int("ingest_workers")
+        set_int("ingest_prefetch")
         set_int("snapshot_period")
         set_str("snapshot_dir")
         set_int("snapshot_keep")
@@ -527,6 +575,20 @@ class Config:
         if c.hist_ordered not in ("auto", "off"):
             log.fatal("Unknown hist_ordered %s (expect auto|off)"
                       % c.hist_ordered)
+        if c.hist_fused not in ("auto", "on", "off"):
+            log.fatal("Unknown hist_fused %s (expect auto|on|off)"
+                      % c.hist_fused)
+        if c.hist_acc not in ("f32", "bf16", "i32"):
+            log.fatal("Unknown hist_acc %s (expect f32|bf16|i32)"
+                      % c.hist_acc)
+        if c.hist_acc != "f32" and c.hist_impl == "xla":
+            log.fatal("hist_acc=%s requires the Pallas histogram kernel "
+                      "(hist_impl=xla was forced)" % c.hist_acc)
+        if c.hist_fused == "on" and c.hist_impl == "xla":
+            log.fatal("hist_fused=on requires the Pallas histogram "
+                      "kernel (hist_impl=xla was forced)")
+        if c.ingest_prefetch < 0:
+            log.fatal("ingest_prefetch must be >= 0 (0 = synchronous)")
         if c.bag_compact not in ("auto", "on", "off"):
             log.fatal("Unknown bag_compact %s (expect auto|on|off)"
                       % c.bag_compact)

@@ -840,6 +840,42 @@ class GBDT:
             if train_data.bin_dtype != np.uint8:
                 log.fatal("hist_impl=pallas requires uint8 bins")
             row_unit = PALLAS_ROW_BLOCK
+        # fused histogram+gain kernel (config.hist_fused) and Pallas
+        # accumulator mode (config.hist_acc).  hist_fused=off keeps the
+        # two-op oracle; auto rides the Pallas fast path (ops/grow.py
+        # additionally gates fusion to the serial child sweeps — the
+        # parallel learners must cross shards between build and scan).
+        self.hist_acc = config.hist_acc
+        if self.hist_acc != "f32":
+            if impl != "pallas":
+                log.fatal("hist_acc=%s requires the Pallas histogram "
+                          "kernel (hist_impl resolved to %s)"
+                          % (self.hist_acc, impl))
+            if config.tree_learner != "serial":
+                log.fatal("hist_acc=%s is serial-learner only (the "
+                          "mesh growers keep the f32 parity "
+                          "accumulators)" % self.hist_acc)
+        if config.hist_fused == "on" and impl != "pallas":
+            log.fatal("hist_fused=on requires the Pallas histogram "
+                      "kernel (hist_impl resolved to %s)" % impl)
+        if config.hist_fused == "on" and config.hist_compact == "on":
+            # same perf-expectation class as the learner warning below:
+            # the compaction path gathers its own rows and keeps the
+            # two-op scan, so forcing fusion next to it does nothing
+            log.warning("hist_fused=on: the small-leaf compaction path "
+                        "(hist_compact=on) gathers its own row buffers "
+                        "and keeps the two-op scan — fusion disengages")
+        if config.hist_fused == "on" and config.tree_learner != "serial":
+            # a warning, not a fatal (unlike hist_acc): the two-op path
+            # the parallel learners keep is BIT-identical to the fused
+            # one — only the perf expectation is wrong, not the numbers
+            log.warning("hist_fused=on: the fused histogram+gain scan "
+                        "is serial-learner only (the parallel learners "
+                        "must cross shards between build and scan); "
+                        "tree_learner=%s keeps the two-op path"
+                        % config.tree_learner)
+        self.hist_fused = (config.hist_fused != "off"
+                           and impl == "pallas")
 
         # data-parallel: shard rows over a device mesh (parallel/mesh.py),
         # replacing the reference's socket/MPI histogram reduce-scatter.
@@ -1120,11 +1156,25 @@ class GBDT:
     def _put_bins_streamed(self, ds) -> jax.Array:
         """Device bins assembled one shard window at a time (out-of-core
         ingest): each [F, k] window device_puts independently and the
-        concatenation happens ON DEVICE, so peak host memory is one
-        window — the full matrix exists only in device memory, where
-        training needs it anyway."""
-        parts = [jax.device_put(np.ascontiguousarray(w))
-                 for w in ds.iter_bin_windows()]
+        concatenation happens ON DEVICE, so peak host memory is
+        2 + ingest_prefetch windows (queued + producer-staged +
+        consumer-held) — the full matrix exists only in device memory,
+        where training needs it anyway.
+
+        Double-buffered since round 16 (config.ingest_prefetch): the
+        windows arrive through a bounded background prefetch thread
+        (ingest/shards.prefetch_windows), so the NEXT shard pages in
+        from disk while the previous window's async device_put transfer
+        is still in flight — the load phase overlaps host IO with
+        host->device copy instead of alternating, and training then
+        runs on the same device-resident state as the in-memory path
+        (shard-fed steady == in-memory steady).  The prefetcher changes
+        WHEN windows are staged, never their order or bytes: shard-fed
+        models are byte-identical with overlap on or off (tested)."""
+        from ..ingest.shards import prefetch_windows
+        parts = [jax.device_put(w)
+                 for w in prefetch_windows(ds.iter_bin_windows(),
+                                           self.config.ingest_prefetch)]
         pad = self.n_pad - ds.num_data
         if pad > 0:
             parts.append(jnp.zeros((ds.num_features, pad),
@@ -1142,7 +1192,14 @@ class GBDT:
         row block assembles on the host (peak: ONE block + one
         window) and device_puts straight to ITS device — no device
         ever stages the full matrix, so per-chip HBM holds 1/S of the
-        data exactly like the host path's sharded placement."""
+        data exactly like the host path's sharded placement.  The
+        single-host leg stages its shard reads through the bounded
+        background prefetch thread (config.ingest_prefetch) so disk IO
+        overlaps the per-device transfers; the mh leg assembles its
+        local block synchronously (its consumer does no per-window
+        work, so prefetch would only add staged-window footprint —
+        see ShardedDataset.local_bins_matrix)."""
+        from ..ingest.shards import prefetch_windows
         if self._mh:
             local = ds.local_bins_matrix()
             if local.shape[1] < self.n_pad:
@@ -1156,7 +1213,8 @@ class GBDT:
         cur = np.zeros((f, block), dtype=ds.bin_dtype)
         pieces = []
         fill = 0
-        for w in ds.iter_bin_windows():
+        for w in prefetch_windows(ds.iter_bin_windows(),
+                                  self.config.ingest_prefetch):
             o = 0
             k = w.shape[1]
             while o < k:
@@ -1315,7 +1373,8 @@ class GBDT:
                     max_bin=self.max_bin, params=self.params,
                     max_depth=cfg.max_depth, hist_impl=self.hist_impl,
                     hist_slots=self.hist_slots, compact=self.hist_compact,
-                    ranged=self.hist_ranged)
+                    ranged=self.hist_ranged, fused=self.hist_fused,
+                    hist_acc=self.hist_acc)
 
     def _bag_mask_dev(self, cls: int):
         """Device/sharded bag mask, uploaded only when bagging changed it."""
@@ -1424,6 +1483,7 @@ class GBDT:
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
+               self.hist_fused, self.hist_acc,
                reorder, compact, k_iters,
                (cfg.hist_agg, self.grower.num_shards,
                 id(self.grower.mesh)) if self.grower is not None else None)
@@ -1897,6 +1957,7 @@ class GBDT:
                self.hist_impl, self.max_bin, max(cfg.num_leaves, 2),
                cfg.max_depth, self.params, len(self.valid_bins_dev),
                self.hist_slots, self.hist_compact, self.hist_ranged,
+               self.hist_fused, self.hist_acc,
                reorder, compact, k_iters,
                # sharded steps close over the mesh and the aggregation
                # protocol — two data-parallel configs that differ only
@@ -3194,7 +3255,8 @@ class DART(GBDT):
         key = ("dart", self.objective.fused_key(), self.dtype,
                self.hist_impl, self.max_bin, L, cfg.max_depth,
                self.params, len(self.valid_bins_dev), self.hist_slots,
-               self.hist_compact, self.hist_ranged, dp, compact, k_iters)
+               self.hist_compact, self.hist_ranged, self.hist_fused,
+               self.hist_acc, dp, compact, k_iters)
 
         def make():
             grow_kw = self._grow_kw()

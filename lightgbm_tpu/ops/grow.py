@@ -38,8 +38,8 @@ import jax.numpy as jnp
 from ..analysis.contracts import contract
 from .histogram import leaf_histogram, make_gvals
 from .predict import predict_leaf_binned
-from .split import (BestSplit, SplitParams, find_best_split, K_MIN_SCORE,
-                    per_feature_best)
+from .split import (BestSplit, SplitParams, find_best_split,
+                    find_best_split_fused, K_MIN_SCORE, per_feature_best)
 
 
 class TreeArrays(NamedTuple):
@@ -149,7 +149,8 @@ def _reduce_best_over_features(s: BestSplit, f_offset, feature_axis: str
     static_argnames=("max_leaves", "max_bin", "params", "max_depth",
                      "row_chunk", "psum_axis", "feature_axis",
                      "voting_top_k", "hist_impl", "hist_agg", "num_shards",
-                     "hist_slots", "compact", "ranged"))
+                     "hist_slots", "compact", "ranged", "fused",
+                     "hist_acc"))
 def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               bag_mask: jax.Array, feature_mask: jax.Array, *,
               max_leaves: int, max_bin: int, params: SplitParams,
@@ -158,13 +159,24 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
               feature_axis: Optional[str] = None,
               voting_top_k: int = 0, hist_impl: str = "xla",
               hist_agg: str = "psum", num_shards: int = 0,
-              hist_slots: int = 0, compact: int = 0, ranged: bool = False):
+              hist_slots: int = 0, compact: int = 0, ranged: bool = False,
+              fused: bool = False, hist_acc: str = "f32"):
     """Grow one leaf-wise tree. Returns (TreeArrays, leaf_id [N] i32).
 
     bins_t [F, N] uint8; grad/hess [N]; bag_mask [N] bool;
     feature_mask [F] bool. All per-split control flow is on-device.
     hist_impl: "xla" (portable one-hot matmul) or "pallas" (TPU radix
     kernel, f32, max_bin<=256, N % 8192 == 0).
+    fused (pallas, serial only — config.hist_fused): per-split child
+    sweeps run the fused histogram+gain kernels, which scan thresholds
+    in-register on the VMEM-resident accumulators and emit per-feature
+    best rows; find_best_split_fused finishes with an O(F) argmax.
+    Bit-parity with fused=False (the retained two-op oracle) in
+    interpret mode — the kernel runs the oracle's exact jnp scan on the
+    exact accumulator values.
+    hist_acc (pallas): "f32" (default, parity), "bf16" (bf16 operands /
+    gh2 stream, f32 accumulate), "i32" (overflow-safe fixed-point
+    integer accumulation, exact counts) — see hist_pallas.make_gh2_acc.
     psum_axis: mesh axis sharding rows (tree_learner=data).
     hist_slots (>0): bound histogram HBM to hist_slots live [F, B, 3]
     leaf histograms — the reference HistogramPool's role
@@ -285,6 +297,26 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
     ranged_on = (ranged and hist_impl == "pallas"
                  and feature_axis is None)
+    # fused histogram+gain path (round 16, config.hist_fused): the
+    # per-split children sweep through the *_fused Pallas kernels, which
+    # run the best-split scan in-register on the VMEM-resident
+    # accumulators and emit per-feature best rows — the two XLA
+    # _split_scan passes per split disappear.  Serial-only: under
+    # psum/scatter/voting/feature the histogram must cross shards BEFORE
+    # the scan, and the small-leaf compaction path gathers its own rows.
+    fused_on = (fused and hist_impl == "pallas" and psum_axis is None
+                and feature_axis is None and not voting and not scatter
+                and compact <= 0)
+    if hist_impl == "pallas":
+        from .hist_pallas import (PALLAS_ROW_BLOCK, fold_leaf_mask,
+                                  leaf_histogram_blocklist,
+                                  leaf_histogram_blocklist_fused,
+                                  leaf_histogram_masked,
+                                  leaf_histogram_masked_fused,
+                                  make_gh2_acc)
+        gh2, inv_scale = make_gh2_acc(grad, hess, hist_acc)
+        # TPU runs the compiled kernel; CPU (tests) uses interpret mode
+        interpret = jax.default_backend() == "cpu"
     if ranged_on:
         # Block-list sweeps (VERDICT r2 #1): per split, sweep ONLY the
         # row blocks that contain the target leaf's rows.  The occupancy
@@ -298,18 +330,13 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         # shard-LOCAL — blocks, occupancy, block list, re-sorts — except
         # the ladder-rung choice below and the histogram reduction the
         # other impls share (hist_psum).
-        from .hist_pallas import (PALLAS_ROW_BLOCK, fold_leaf_mask,
-                                  leaf_histogram_blocklist, make_gh2)
-        gh2 = make_gh2(grad, hess)
-        interpret = jax.default_backend() == "cpu"
         nblocks = n // PALLAS_ROW_BLOCK
         # static grid-size ladder: the per-call floor is ~grid_blocks x
         # the per-step bookkeeping, so deep (small) leaves dispatch to a
         # small-grid variant
         ladder = [g for g in (8, 32) if g < nblocks] + [nblocks]
 
-        def hist_leaf(leaf_id, target):
-            leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
+        def _block_plan(leaf_eff, target):
             occ = (leaf_eff == target).reshape(
                 nblocks, PALLAS_ROW_BLOCK).any(axis=1)
             n_occ = jnp.sum(occ).astype(jnp.int32)
@@ -326,28 +353,58 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
             sel = jnp.int32(len(ladder) - 1)
             for i in range(len(ladder) - 2, -1, -1):
                 sel = jnp.where(n_sel <= ladder[i], jnp.int32(i), sel)
+            return blist, n_occ, sel
+
+        def hist_leaf(leaf_id, target):
+            leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
+            blist, n_occ, sel = _block_plan(leaf_eff, target)
 
             def mk(g):
                 def branch(le, bl, na):
                     return leaf_histogram_blocklist(
                         bins_t, gh2, le, target, bl, na, max_bin=max_bin,
+                        hist_acc=hist_acc, inv_scale=inv_scale,
                         grid_blocks=g, interpret=interpret).astype(dtype)
                 return branch
 
             return hist_psum(jax.lax.switch(sel, [mk(g) for g in ladder],
                                             leaf_eff, blist, n_occ))
-    elif hist_impl == "pallas":
-        from .hist_pallas import (fold_leaf_mask, leaf_histogram_masked,
-                                  make_gh2)
-        gh2 = make_gh2(grad, hess)
-        # TPU runs the compiled kernel; CPU (tests) uses interpret mode
-        interpret = jax.default_backend() == "cpu"
 
+        if fused_on:
+            def hist_best(leaf_id, target, parent_hist, s_stats, l_stats):
+                leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
+                blist, n_occ, sel = _block_plan(leaf_eff, target)
+
+                def mk(g):
+                    def branch(le, bl, na):
+                        h, pfs, pfl = leaf_histogram_blocklist_fused(
+                            bins_t, gh2, le, target, bl, na, parent_hist,
+                            feature_mask, s_stats, l_stats, inv_scale,
+                            max_bin=max_bin, params=params,
+                            hist_acc=hist_acc, grid_blocks=g,
+                            interpret=interpret)
+                        return h.astype(dtype), pfs, pfl
+                    return branch
+
+                return jax.lax.switch(sel, [mk(g) for g in ladder],
+                                      leaf_eff, blist, n_occ)
+    elif hist_impl == "pallas":
         def hist_leaf(leaf_id, target):
             leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
             return hist_psum(leaf_histogram_masked(
-                bins_t, gh2, leaf_eff, target,
-                max_bin=max_bin, interpret=interpret).astype(dtype))
+                bins_t, gh2, leaf_eff, target, max_bin=max_bin,
+                hist_acc=hist_acc, inv_scale=inv_scale,
+                interpret=interpret).astype(dtype))
+
+        if fused_on:
+            def hist_best(leaf_id, target, parent_hist, s_stats, l_stats):
+                leaf_eff = fold_leaf_mask(leaf_id, bag_mask)
+                h, pfs, pfl = leaf_histogram_masked_fused(
+                    bins_t, gh2, leaf_eff, target, parent_hist,
+                    feature_mask, s_stats, l_stats, inv_scale,
+                    max_bin=max_bin, params=params, hist_acc=hist_acc,
+                    interpret=interpret)
+                return h.astype(dtype), pfs, pfl
     else:
         def hist_leaf(leaf_id, target):
             gv = make_gvals(grad, hess, (leaf_id == target) & bag_mask, dtype)
@@ -397,7 +454,9 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                     .astype(jnp.int32)
                 return leaf_histogram_masked(
                     bins_c, gh2_c, leaf_c, jnp.int32(0),
-                    max_bin=max_bin, interpret=interpret).astype(dtype)
+                    max_bin=max_bin, hist_acc=hist_acc,
+                    inv_scale=inv_scale,
+                    interpret=interpret).astype(dtype)
         else:
             def _hist_rows(idx, cnt, cap):
                 bins_c = jnp.take(bins_t, idx[:cap], axis=1)
@@ -542,7 +601,6 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
         left_is_smaller = si[BI_LCNT] <= si[BI_RCNT]
         small_leaf = jnp.where(left_is_smaller, bl, right)
         small_cnt = jnp.where(left_is_smaller, si[BI_LCNT], si[BI_RCNT])
-        small_hist = hist_small(leaf_id, small_leaf, small_cnt)
         if pooled:
             # parent histogram from its pool slot, or a full recompute
             # when it was LRU-evicted (the reference recomputes evicted
@@ -555,6 +613,21 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                 lambda: hist_leaf(st.leaf_id, bl))
         else:
             parent_hist = st.hist[bl]
+        if fused_on:
+            # fused sweep + in-register gain scan: the kernel consumes
+            # the parent block, sweeps the small child, and emits both
+            # children's per-feature best rows alongside the histogram
+            s_g = jnp.where(left_is_smaller, sf[BF_LG], sf[BF_RG])
+            s_h = jnp.where(left_is_smaller, sf[BF_LH], sf[BF_RH])
+            l_g = jnp.where(left_is_smaller, sf[BF_RG], sf[BF_LG])
+            l_h = jnp.where(left_is_smaller, sf[BF_RH], sf[BF_LH])
+            large_cnt = jnp.where(left_is_smaller, si[BI_RCNT],
+                                  si[BI_LCNT])
+            small_hist, pf_small, pf_large = hist_best(
+                leaf_id, small_leaf, parent_hist,
+                (small_cnt, s_g, s_h), (large_cnt, l_g, l_h))
+        else:
+            small_hist = hist_small(leaf_id, small_leaf, small_cnt)
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_is_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_is_smaller, large_hist, small_hist)
@@ -597,10 +670,21 @@ def grow_tree(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # --- best splits for the two children ---
         child_depth = new_tree.leaf_depth[bl]
-        lbest = best_of(left_hist, si[BI_LCNT], sf[BF_LG], sf[BF_LH])
+        if fused_on:
+            # finish from the kernel's per-feature rows: a tiny argmax
+            # over [F, 8] instead of two full [F, B, 3] scan passes
+            lpf = jnp.where(left_is_smaller, pf_small, pf_large)
+            rpf = jnp.where(left_is_smaller, pf_large, pf_small)
+            lbest = find_best_split_fused(lpf, sf[BF_LG], sf[BF_LH],
+                                          params)
+            rbest = find_best_split_fused(rpf, sf[BF_RG], sf[BF_RH],
+                                          params)
+        else:
+            lbest = best_of(left_hist, si[BI_LCNT], sf[BF_LG], sf[BF_LH])
+            rbest = best_of(right_hist, si[BI_RCNT], sf[BF_RG],
+                            sf[BF_RH])
         lbf, lbi = _pack_best(lbest._replace(
             gain=depth_gated(lbest.gain, child_depth)), dtype)
-        rbest = best_of(right_hist, si[BI_RCNT], sf[BF_RG], sf[BF_RH])
         rbf, rbi = _pack_best(rbest._replace(
             gain=depth_gated(rbest.gain, child_depth)), dtype)
         best_f = st.best_f.at[wl].set(lbf).at[wr].set(rbf)

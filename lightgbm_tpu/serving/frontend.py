@@ -47,16 +47,27 @@ from ..config import Config
 from ..resilience.faults import faultpoint
 from ..utils import log
 
-#: a worker that dies within this many seconds of its spawn is crash-
-#: looping: respawns back off instead of spinning hot
-CRASH_LOOP_S = 2.0
 RESPAWN_BACKOFF_S = 0.5
 RESPAWN_BACKOFF_MAX_S = 30.0
-#: consecutive fast deaths per slot before the supervisor gives up —
-#: but ONLY while NO worker has ever run stably (a broken model/config
-#: at startup should exit with the diagnostic, like the single-process
-#: server does; once the fleet has been healthy, respawns retry forever)
+#: consecutive never-became-ready deaths per slot before the supervisor
+#: gives up — but ONLY while NO worker has ever signaled readiness (a
+#: broken model/config at startup should exit with the diagnostic, like
+#: the single-process server does; once the fleet has been healthy,
+#: respawns retry forever).  "Ready" is an explicit event handshake —
+#: the worker touches its per-slot ready file once its server is
+#: listening — NOT a wall-clock age check: under heavy host contention
+#: a crash-looping worker can take arbitrarily long to start Python and
+#: die, and a time-based classifier misread that as stability (the
+#: pre-round-16 flake in test_frontend_startup_crash_loop_gives_up).
 STARTUP_CRASH_LIMIT = 3
+
+#: a worker that dies within this long of its spawn DESPITE having
+#: completed the readiness handshake throttles its slot's respawns
+#: (exponential, same ceiling as the unready path).  Wall clock here
+#: paces sleeps ONLY — it never classifies stability or counts toward
+#: the give-up, so the contention flake the handshake fixed cannot
+#: come back through it (worst case: a healthy respawn waits a bit).
+POST_READY_FAST_S = 2.0
 
 #: repo/package parent directory — prepended to the workers' PYTHONPATH
 #: so `python -m lightgbm_tpu.serving.frontend` resolves even when the
@@ -65,7 +76,8 @@ _PKG_PARENT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _worker_main(cfg: Config, idx: int, port: int) -> None:
+def _worker_main(cfg: Config, idx: int, port: int,
+                 ready_path: Optional[str] = None) -> None:
     """Body of one front-end worker process (fresh interpreter, so this
     re-applies the per-process setup the CLI would have done — log
     level, fault schedule, device platform)."""
@@ -87,19 +99,29 @@ def _worker_main(cfg: Config, idx: int, port: int) -> None:
     server = ServingServer(cfg, reuse_port=True, worker_index=idx)
     log.info("serve worker %d (pid %d) listening on port %d"
              % (idx, os.getpid(), port))
+    if ready_path:
+        # readiness handshake: the model parsed, the forest warmed and
+        # the socket is listening — only now does the supervisor count
+        # this slot as stable (see STARTUP_CRASH_LIMIT).  A marker
+        # file, not a pipe: survives supervisor embedding styles and
+        # costs one stat per monitor sweep.
+        with open(ready_path, "w") as rf:
+            rf.write(str(os.getpid()))
     run_until_signal(server)
 
 
 def worker_entry(argv: List[str]) -> int:
     """`python -m lightgbm_tpu.serving.frontend <cfg.json> <idx>
-    <port>` — the subprocess entry the supervisor spawns."""
-    if len(argv) != 3:
+    <port> [ready_file]` — the subprocess entry the supervisor
+    spawns."""
+    if len(argv) not in (3, 4):
         log.warning("usage: python -m lightgbm_tpu.serving.frontend "
-                    "<cfg.json> <worker_idx> <port>")
+                    "<cfg.json> <worker_idx> <port> [ready_file]")
         return 2
     with open(argv[0]) as f:
         cfg = Config(**json.load(f))
-    _worker_main(cfg, int(argv[1]), int(argv[2]))
+    _worker_main(cfg, int(argv[1]), int(argv[2]),
+                 argv[3] if len(argv) == 4 else None)
     return 0
 
 
@@ -127,6 +149,7 @@ class Frontend:
         self._draining = False
         self._reserve: Optional[socket.socket] = None
         self._cfg_path: Optional[str] = None
+        self._ready_dir: Optional[str] = None
         self.port = cfg.serve_port
 
     # -- lifecycle -------------------------------------------------------
@@ -143,10 +166,12 @@ class Frontend:
                                         suffix=".json")
         with os.fdopen(fd, "w") as f:
             json.dump(dataclasses.asdict(self.cfg), f)
+        ready_dir = tempfile.mkdtemp(prefix="lgbm_serve_ready_")
         with self._lock:
             self._reserve = s
             self.port = s.getsockname()[1]
             self._cfg_path = cfg_path
+            self._ready_dir = ready_dir
         for idx in range(self.num_workers):
             self._spawn(idx)
         log.info("Front-end: %d workers on http://%s:%d (pids %s)"
@@ -154,17 +179,34 @@ class Frontend:
                     ",".join(str(p.pid) for p in self._workers
                              if p is not None)))
 
+    def _ready_path(self, idx: int) -> str:
+        assert self._ready_dir is not None
+        return os.path.join(self._ready_dir, "worker_%d.ready" % idx)
+
+    def _is_ready(self, idx: int) -> bool:
+        """Has this slot's CURRENT worker completed the readiness
+        handshake (server listening, marker file written)?"""
+        return (self._ready_dir is not None
+                and os.path.exists(self._ready_path(idx)))
+
     def _spawn(self, idx: int) -> None:
         # the spawn seam is chaos-testable: a schedule can fail the
         # Nth (re)spawn to prove the supervisor survives and retries
         faultpoint("frontend.spawn")
         assert self._cfg_path is not None
+        # clear the slot's previous handshake: readiness must come from
+        # THIS worker, not a dead predecessor's stale marker
+        try:
+            os.unlink(self._ready_path(idx))
+        except OSError:
+            pass
         env = dict(os.environ)
         env["PYTHONPATH"] = (_PKG_PARENT + os.pathsep
                              + env.get("PYTHONPATH", ""))
         proc = subprocess.Popen(
             [sys.executable, "-m", "lightgbm_tpu.serving.frontend",
-             self._cfg_path, str(idx), str(self.port)],
+             self._cfg_path, str(idx), str(self.port),
+             self._ready_path(idx)],
             env=env)
         with self._lock:
             self._workers[idx] = proc
@@ -176,37 +218,71 @@ class Frontend:
     # -- supervision -----------------------------------------------------
     def _monitor_once(self, timeout: float = 1.0) -> None:
         """Poll the workers; respawn what died (unless draining).  A
-        worker that died right after its spawn is crash-looping — back
-        off EXPONENTIALLY so a broken model/config does not spin the
-        host at 100% respawning, and if the fleet has NEVER been stable
-        (no worker outlived CRASH_LOOP_S) give up after
-        STARTUP_CRASH_LIMIT strikes per slot: a typo'd input_model
-        should exit with the worker's diagnostic, exactly like the
-        single-process server does."""
+        worker that died WITHOUT completing its readiness handshake is
+        crash-looping — back off EXPONENTIALLY so a broken model/config
+        does not spin the host at 100% respawning, and if the fleet has
+        NEVER been ready (no worker ever wrote its ready marker) give
+        up after STARTUP_CRASH_LIMIT strikes per slot: a typo'd
+        input_model should exit with the worker's diagnostic, exactly
+        like the single-process server does.  Readiness is the event
+        handshake from _worker_main, never a wall-clock age — a slow
+        host cannot promote a crash-looper to 'stable', nor demote a
+        healthy-but-slow startup to a strike."""
         died = False
         for idx, proc in enumerate(list(self._workers)):
             if proc is None or self._draining:
                 continue
+            ready = self._is_ready(idx)
             code = proc.poll()
             if code is None:
-                if time.monotonic() - self._spawned_at[idx] \
-                        >= CRASH_LOOP_S:
+                if ready:
                     with self._lock:
-                        self._fast_deaths[idx] = 0
                         self._ever_stable = True
+                        # the post-ready throttle counter clears only
+                        # once the worker has SURVIVED the fast window
+                        # — an alive sweep landing between a 0.2 s
+                        # handshake and a 1.5 s crash must not reset
+                        # the escalation (pacing only, like the rest
+                        # of the wall-clock use here)
+                        if (time.monotonic() - self._spawned_at[idx]
+                                >= POST_READY_FAST_S):
+                            self._fast_deaths[idx] = 0
                 continue
             died = True
-            fast = (time.monotonic() - self._spawned_at[idx]
-                    < CRASH_LOOP_S)
+            # re-sample AFTER poll observed the death: a worker that
+            # wrote its marker and exited between the two calls above
+            # must not be misread as a pre-ready strike (the marker
+            # state is final once the process is dead)
+            ready = ready or self._is_ready(idx)
+            fast = not ready   # died before ever serving = a strike
+            throttle = 0
+            if ready:
+                # the worker completed its handshake before dying — the
+                # fleet WAS healthy (credit it even when the death fell
+                # between two sweeps), so this death never counts toward
+                # the startup give-up.  It still THROTTLES: a worker
+                # that keeps crashing moments after becoming ready
+                # would otherwise respawn at full interpreter-spawn
+                # speed forever — back its slot off exponentially
+                # (pacing only; see POST_READY_FAST_S).
+                fast_post = (time.monotonic() - self._spawned_at[idx]
+                             < POST_READY_FAST_S)
+                with self._lock:
+                    self._ever_stable = True
+                    if fast_post:
+                        self._fast_deaths[idx] += 1
+                        throttle = self._fast_deaths[idx]
+                    else:
+                        self._fast_deaths[idx] = 0
             log.warning("serve worker %d (pid %s) died (exit %s)%s — "
                         "respawning"
                         % (idx, proc.pid, code,
-                           " after a crash-loop backoff" if fast
-                           else ""))
+                           " before its readiness handshake (crash-"
+                           "loop backoff)" if fast else ""))
             if fast:
                 with self._lock:
                     self._fast_deaths[idx] += 1
-                    strikes = self._fast_deaths[idx]
+                    throttle = self._fast_deaths[idx]
                     hopeless = not self._ever_stable and all(
                         n >= STARTUP_CRASH_LIMIT
                         for n in self._fast_deaths)
@@ -216,8 +292,11 @@ class Frontend:
                         "startup (see the worker diagnostics above) — "
                         "giving up instead of respawning forever"
                         % STARTUP_CRASH_LIMIT)
+            if throttle:
+                # one backoff curve for both crash-loop flavors
+                # (pre-ready strikes and post-ready fast deaths)
                 time.sleep(min(
-                    RESPAWN_BACKOFF_S * (2 ** (strikes - 1)),
+                    RESPAWN_BACKOFF_S * (2 ** (throttle - 1)),
                     RESPAWN_BACKOFF_MAX_S))
             try:
                 self._spawn(idx)
@@ -280,6 +359,11 @@ class Frontend:
                 pass
             with self._lock:
                 self._cfg_path = None
+        if self._ready_dir is not None:
+            import shutil
+            shutil.rmtree(self._ready_dir, ignore_errors=True)
+            with self._lock:
+                self._ready_dir = None
 
     def run_forever(self) -> None:
         """Supervise until SIGTERM/SIGINT, then fan out the drain."""
