@@ -25,11 +25,20 @@ This package machine-checks them:
                 the `__jax_free__` module marker), zero-cost at runtime.
   callgraph.py  package-wide symbol table + call graph: module/import
                 resolution, method binding, closures, factories.
-  graftcheck.py whole-program contract analysis (rules GC001-GC007):
+  graftcheck.py whole-program contract analysis (rules GC001-GC008):
                 taint/effect propagation ACROSS calls — a host sync
                 three helpers below a traced entry point, a transitive
                 jax import two hops below a jax-free module, a serving
                 mutator reachable from an unlocked public method.
+  graftsync.py  SPMD collective-safety analysis (rules GC009-GC011):
+                host-collective SEQUENCES identical across ranks —
+                rank-gated/reordered collectives, collective loops
+                with rank-local trip counts, multihost calls outside
+                parallel/dist.py.  The runtime side lives in
+                parallel/dist.trace_collectives.
+  lockgraph.py  lock-order analysis (rule GC012): acquisition cycles
+                and blocking operations (cold loads, dispatch, socket
+                I/O) under fast serving locks.
   mutations.py  seeded-violation corpus: deliberate contract breaks
                 applied as source transforms to copies of the real
                 modules, proving every rule catches its bug class

@@ -10,6 +10,8 @@ Options:
   --list-rules     print the rule table and exit
   --no-typegate    skip the typing gate
   --no-graftcheck  skip the whole-program contract analysis
+  --no-graftsync   skip the SPMD collective-sequence + lock-order
+                   rules (GC009-GC012) within the graftcheck pass
   --json           machine-readable findings (one object per line:
                    {"path", "line", "rule", "message"})
   --baseline FILE  suppress findings recorded in FILE (a JSON list of
@@ -77,6 +79,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     as_json = False
     typegate = True
     graftcheck = True
+    graftsync = True
     baseline_path: Optional[str] = None
     paths: List[str] = []
     i = 0
@@ -97,6 +100,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             typegate = False
         elif arg == "--no-graftcheck":
             graftcheck = False
+        elif arg == "--no-graftsync":
+            graftsync = False
         elif arg == "--baseline":
             if i + 1 >= len(argv):
                 print("--baseline needs a file argument", file=sys.stderr)
@@ -120,7 +125,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             from .graftcheck import run_graftcheck
             scope = ([_rel_to_package(p) for p in paths] if paths
                      else None)
-            findings += run_graftcheck(paths=scope)
+            findings += run_graftcheck(paths=scope, graftsync=graftsync)
         if typegate:
             if paths:
                 # explicit paths scope the run but must not silently

@@ -70,6 +70,19 @@ Contract classes (checking rules live in graftcheck.py):
       footer), because a bare binary write crash-truncates in place
       and poisons every later run.  Rule GC008.
 
+  @contract.rank_uniform
+      This function's RETURN VALUE is identical on every rank — it is
+      derived only from fingerprint-synced config, collective results
+      (vote_any / sync_max_ints / process_allgather), or deterministic
+      counters that advance in lockstep.  The SPMD-divergence analyzer
+      (graftsync, rules GC009/GC010) accepts a branch condition or
+      loop bound fed by such a call as rank-uniform; everything else
+      defaults to rank-LOCAL, because a collective behind a rank-local
+      branch hangs the whole pool with no diagnostic.  Annotating a
+      function that actually returns rank-local state disables the
+      analyzer's protection for its callers — the annotation is a
+      reviewed claim, like parity_oracle's note.  Rules GC009-GC010.
+
 Module marker — jax-free modules declare themselves:
 
     __jax_free__ = True     # module + its import closure never pull jax
@@ -168,6 +181,102 @@ COLLECTIVE_OPS: Tuple[str, ...] = (
 )
 
 # ---------------------------------------------------------------------------
+# SPMD collective-sequence vocabulary (graftsync, rules GC009-GC011)
+# ---------------------------------------------------------------------------
+
+#: host-level collective wrappers exported by parallel/dist.py — the
+#: ATOMS of the SPMD sequence model.  Every rank must execute these in
+#: an identical order; graftsync verifies the order statically and the
+#: runtime tracer (dist.trace_collectives) verifies it live.
+HOST_COLLECTIVES: Tuple[str, ...] = (
+    "process_allgather", "vote_any", "process_concat", "sync_max_ints",
+    "sync_config_by_min", "check_config_fingerprint",
+)
+
+#: the ONE module allowed to touch jax.experimental.multihost_utils /
+#: jax.distributed directly (rule GC011): every blocking host
+#: collective must funnel through its wrappers so it inherits the
+#: call_with_deadline degrade-don't-hang wrapping and the runtime
+#: trace.  A bare multihost call anywhere else is a finding.
+COLLECTIVE_ENTRY_MODULE = "parallel/dist.py"
+
+#: names that are rank-LOCAL no matter what: a branch/loop condition
+#: touching one of these can never be rank-uniform.  Matches bare
+#: names, parameters, and any attribute segment (`self.rank`,
+#: `config.rank` included — a per-rank id stays per-rank wherever it
+#: is stored).
+RANK_VARYING_NAMES: Tuple[str, ...] = (
+    "rank", "process_id", "process_index", "row_rank", "local_rows",
+    "local_ips",
+)
+
+#: instance-attribute names the analyzer accepts as rank-uniform.
+#: Each entry is a reviewed claim about how the attribute is computed;
+#: adding one without the justification holding re-opens the silent
+#: SPMD-hang class GC009/GC010 exist to close.
+RANK_UNIFORM_ATTRS: Tuple[str, ...] = (
+    # config-derived (fingerprint-checked by check_config_fingerprint)
+    "num_machines", "num_shards", "period", "keep", "max_iteration",
+    "resume", "snapshots", "config", "cfg", "params",
+    # jax.process_count()-derived flags, identical on every process
+    "_mh", "_mh_fused", "_feat_mh",
+    # training counters/state that advance in lockstep on every rank
+    # (resume agreement pins the starting point, segments advance
+    # uniformly, every rank grows the identical model)
+    "iter", "num_used_model", "_models", "_bank",
+    # bagging-compaction state: the window is config-shaped and the
+    # overflow/arranged flags are sync_max_ints-agreed across ranks
+    # (gbdt._bag_window_overflow) before anyone acts on them
+    "_bag_window", "_bag_overflowed", "_bag_arranged",
+    "_fused_sharded",
+)
+
+#: external calls whose results are identical on every rank.
+#: jax.process_index is deliberately ABSENT — it is the canonical
+#: rank-local value.
+RANK_UNIFORM_CALLS: Tuple[str, ...] = (
+    "jax.process_count", "jax.device_count",
+)
+
+# ---------------------------------------------------------------------------
+# Lock-order vocabulary (lockgraph, rule GC012)
+# ---------------------------------------------------------------------------
+
+#: package functions that BLOCK (device dispatch, model parse+warm,
+#: file/socket-bound work): holding a serving hot-path lock across one
+#: stalls every thread behind that lock for the operation's duration.
+BLOCKING_FUNCTIONS: Tuple[str, ...] = (
+    "serving/forest.py::load_forest",
+    "serving/forest.py::ServingForest.warm",
+    "serving/forest.py::ServingForest.predict",
+    "serving/forest.py::ServingForest.predict_text",
+    "serving/fleet.py::ModelFleet._load_fresh",
+    "serving/batcher.py::MicroBatcher.submit",
+)
+
+#: attribute-call terminals treated as blocking operations (socket
+#: I/O, subprocess waits, sleeps).  `.wait()` on the HELD condition
+#: variable is exempt — releasing the lock while waiting is the whole
+#: point of a CV.
+BLOCKING_ATTR_CALLS: Tuple[str, ...] = (
+    "accept", "recv", "recvfrom", "sendall", "connect", "communicate",
+    "sleep", "wait",
+)
+
+#: locks ALLOWED to be held across blocking operations, with the
+#: justification (rendered in --list-rules style docs).  Everything
+#: else is a fast lock: fleet.py's loads-outside-pool-lock discipline,
+#: machine-checked instead of comment-enforced.
+LOCK_ALLOWED_BLOCKING: Mapping[str, str] = {
+    "ModelFleet._load_lock":
+        "exists to serialize cold model loads; the pool lock stays "
+        "free so warm hits keep serving",
+    "ServingState._swap_lock":
+        "serializes /reload only and is never taken on the request "
+        "path; the old forest keeps serving while the fresh one warms",
+}
+
+# ---------------------------------------------------------------------------
 # Registries: the annotation SET is part of the contract
 # ---------------------------------------------------------------------------
 
@@ -260,9 +369,17 @@ class _Contract:
     def durable_write(fn: F) -> F:
         return _tag(fn, "durable_write", {})
 
+    @staticmethod
+    def rank_uniform(fn: F) -> F:
+        return _tag(fn, "rank_uniform", {})
+
 
 contract = _Contract()
 
 __all__ = ["contract", "CONTRACT_ATTR", "JAX_FREE_MARKER", "DECLARE_DIRS",
            "FUSED_CORE", "CONSUME_KINDS", "COLLECTIVE_OPS",
-           "EXPECTED_FUSED_BODIES", "EXPECTED_PARITY_ORACLES"]
+           "EXPECTED_FUSED_BODIES", "EXPECTED_PARITY_ORACLES",
+           "HOST_COLLECTIVES", "COLLECTIVE_ENTRY_MODULE",
+           "RANK_VARYING_NAMES", "RANK_UNIFORM_ATTRS",
+           "RANK_UNIFORM_CALLS", "BLOCKING_FUNCTIONS",
+           "BLOCKING_ATTR_CALLS", "LOCK_ALLOWED_BLOCKING"]

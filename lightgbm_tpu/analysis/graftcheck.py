@@ -55,6 +55,13 @@ boundary; these rules cross it:
         place when the process dies mid-write, and a truncated
         cache/snapshot/model poisons every later run.
 
+Two sibling analyzers run in the same pass and share this module's
+entry points and Finding stream: graftsync.py (GC009 collective-
+sequence-divergence, GC010 collective-in-rank-local-loop, GC011
+collective-outside-dist — the static SPMD collective-safety rules) and
+lockgraph.py (GC012 lock-order: acquisition cycles and blocking
+operations under fast serving locks).  See their module docstrings.
+
 Entry points: run_graftcheck() for the installed package (or an
 explicit root), run_graftcheck_sources() for an in-memory
 {relpath: source} mapping (unit tests, the seeded-violation harness).
@@ -83,6 +90,14 @@ CHECK_RULES: Dict[str, str] = {
     "GC007": "jax-free-undeclared",
     "GC008": "unsanctioned-durable-write",
 }
+# graftsync (SPMD collective sequences, GC009-GC011) and lockgraph
+# (lock order, GC012) run as part of every graftcheck pass — same
+# graph, same Finding stream, same exit-code/baseline contract
+from .graftsync import SYNC_RULES, run_graftsync_graph  # noqa: E402
+from .lockgraph import LOCK_RULES, run_lockgraph_graph  # noqa: E402
+
+CHECK_RULES.update(SYNC_RULES)
+CHECK_RULES.update(LOCK_RULES)
 RULE_NAMES.update(CHECK_RULES)
 
 
@@ -207,7 +222,22 @@ def _call_under_lock(call: ast.AST, lock: str) -> bool:
 def check_lock_discipline(graph: CallGraph,
                           findings: List[Finding]) -> None:
     from .callgraph import own_nodes
-    for target in graph.contracted("locked_by"):
+    targets = graph.contracted("locked_by")
+    # one package scan indexes every attribute call by method name —
+    # the per-target fallback below then reads the index instead of
+    # re-walking the whole tree per contract
+    wanted = {t.name for t in targets}
+    attr_calls: Dict[str, List[Tuple[FunctionInfo, ast.Call]]] = {}
+    if wanted:
+        for mod in graph.modules.values():
+            for fn in mod.all_functions:
+                for node in own_nodes(fn.node):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in wanted:
+                        attr_calls.setdefault(node.func.attr,
+                                              []).append((fn, node))
+    for target in targets:
         lock = str(target.contracts["locked_by"].get("lock", "_lock"))
         if lock in graph.effects(target).acquired_locks:
             continue  # self-acquiring: discipline holds locally
@@ -222,16 +252,9 @@ def check_lock_discipline(graph: CallGraph,
         # same-named method of an unrelated class gets flagged and
         # must rename or take the lock; for a lock rule that is the
         # right direction to fail in.
-        for mod in graph.modules.values():
-            for fn in mod.all_functions:
-                if fn is target:
-                    continue
-                for node in own_nodes(fn.node):
-                    if isinstance(node, ast.Call) \
-                            and isinstance(node.func, ast.Attribute) \
-                            and node.func.attr == target.name \
-                            and id(node) not in resolved_ids:
-                        sites.append((fn, node))
+        for fn, node in attr_calls.get(target.name, []):
+            if fn is not target and id(node) not in resolved_ids:
+                sites.append((fn, node))
         if not sites:
             _emit(findings, target.module.rel,
                   getattr(target.node, "lineno", 1), "GC004",
@@ -554,7 +577,8 @@ def check_declarations(graph: CallGraph,
 # Entry points
 # ---------------------------------------------------------------------------
 
-def run_graftcheck_graph(graph: CallGraph) -> List[Finding]:
+def run_graftcheck_graph(graph: CallGraph,
+                         graftsync: bool = True) -> List[Finding]:
     findings: List[Finding] = []
     for rel, msg in graph.errors:
         _emit(findings, rel, 1, "GC007", "unparseable module: %s" % msg)
@@ -566,6 +590,9 @@ def run_graftcheck_graph(graph: CallGraph) -> List[Finding]:
     check_counted_flush(graph, findings)
     check_durable_writes(graph, findings)
     check_declarations(graph, findings)
+    if graftsync:
+        findings += run_graftsync_graph(graph)
+        findings += run_lockgraph_graph(graph)
     # stable order + dedup (one defect can surface through two roots)
     uniq: Dict[Tuple[str, int, str, str], Finding] = {}
     for f in findings:
@@ -576,14 +603,14 @@ def run_graftcheck_graph(graph: CallGraph) -> List[Finding]:
 
 
 def run_graftcheck(root: Optional[str] = None,
-                   paths: Optional[Iterable[str]] = None
-                   ) -> List[Finding]:
+                   paths: Optional[Iterable[str]] = None,
+                   graftsync: bool = True) -> List[Finding]:
     """Analyze the package rooted at `root` (default: the installed
     lightgbm_tpu).  `paths` optionally filters the REPORTED findings to
     the given package-relative module paths; the analysis itself is
     always whole-program (the rules are interprocedural)."""
     graph = CallGraph.from_root(root)
-    findings = run_graftcheck_graph(graph)
+    findings = run_graftcheck_graph(graph, graftsync=graftsync)
     if paths is not None:
         keep = {p.replace("\\", "/") for p in paths}
         findings = [f for f in findings if f.path in keep]

@@ -1,0 +1,741 @@
+"""graftsync — static SPMD collective-safety analysis (GC009-GC011).
+
+The class of bug that kills a distributed GBDT run is one rank
+skipping or reordering a HOST collective behind a rank-local branch:
+every other rank blocks inside its allgather with no diagnostic until
+the deadline fires.  graftcheck GC005 already verifies the SET of
+device collectives per fused body is uniform; these rules verify the
+SEQUENCE of host-level collectives (parallel/dist.py wrappers:
+process_allgather, vote_any, sync_max_ints, process_concat, the
+config/fingerprint syncs) is identical across control-flow paths on
+every rank.
+
+  GC009 collective-sequence-divergence
+        A branch whose condition is NOT provably rank-uniform emits
+        different collective sequences on its arms (including "one arm
+        emits, the other doesn't" and "same set, different order"), or
+        exits a collective-emitting function early on one rank only.
+        Conditions count as rank-uniform when they derive from
+        fingerprint-synced config, collective results (vote_any /
+        sync_max_ints / process_allgather return identical values on
+        every rank), jax.process_count(), or calls annotated
+        @contract.rank_uniform; a `log.fatal`/`raise` arm is exempt —
+        an aborting rank surfaces as a typed NetworkError on its peers
+        via the call_with_deadline wrapping, not as a silent hang.
+  GC010 collective-in-rank-local-loop
+        A loop whose trip count is not provably rank-uniform contains
+        a collective (directly or through any resolvable call chain),
+        or a rank-local break/return inside a collective-emitting
+        loop: ranks would run different collective COUNTS.
+  GC011 collective-outside-dist
+        Direct use of jax.experimental.multihost_utils or
+        jax.distributed outside parallel/dist.py: every blocking host
+        collective must funnel through the dist.py wrappers so it
+        inherits the per-collective deadline (NetworkError instead of
+        an indefinite hang) and the runtime collective trace.
+
+Model notes (deliberate approximations, both conservative for the
+sequences they CAN see): calls the resolver cannot bind (values passed
+as parameters, `self.stop_sync(...)`-style hooks) contribute no atoms
+— the runtime tracer (dist.trace_collectives) is the complementary
+check that sees every dynamic call; lambdas and nested defs emit
+nothing at definition site (they run when invoked).  Uniformity is a
+statement-order dataflow over one function: a name is rank-uniform at
+a use iff its latest assignment was uniform (so vote-then-branch, the
+tree's standard pattern, resolves correctly), names assigned under a
+rank-LOCAL branch are poisoned afterwards (whether the assignment ran
+depends on the rank), `while` heads are re-checked against the
+post-body environment (the head re-evaluates every iteration), and
+names in contracts.RANK_VARYING_NAMES never launder to uniform.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo, _dotted
+from .contracts import (COLLECTIVE_ENTRY_MODULE, HOST_COLLECTIVES,
+                        RANK_UNIFORM_ATTRS, RANK_UNIFORM_CALLS,
+                        RANK_VARYING_NAMES)
+from .graftlint import Finding
+
+__jax_free__ = True
+
+SYNC_RULES: Dict[str, str] = {
+    "GC009": "collective-sequence-divergence",
+    "GC010": "collective-in-rank-local-loop",
+    "GC011": "collective-outside-dist",
+}
+
+#: builtins that preserve rank-uniformity of their arguments
+_UNIFORM_BUILTINS = {
+    "int", "float", "bool", "str", "len", "min", "max", "abs", "sum",
+    "any", "all", "round", "sorted", "tuple", "set", "frozenset",
+    "range", "enumerate", "zip", "isinstance", "getattr", "hasattr",
+    "type",
+}
+
+#: names denoting pure value namespaces: a method chained off one is
+#: uniform when its arguments are.  `os` is deliberately absent —
+#: os.path.exists/os.listdir read the rank-LOCAL filesystem.
+_UNIFORM_ROOTS = {"np", "numpy", "math", "set", "frozenset"}
+
+# Sequence events (compared structurally):
+#   ("c", name)                   one host collective
+#   ("br", arm_a, arm_b)          rank-uniform branch, differing arms
+#   ("loop", body)                rank-uniform loop over a collective body
+_Seq = Tuple[object, ...]
+
+
+def _terminal(dotted: Optional[str]) -> str:
+    return dotted.rpartition(".")[2] if dotted else ""
+
+
+def _is_abort_call(dotted: Optional[str]) -> bool:
+    return dotted in ("log.fatal", "sys.exit", "os._exit", "exit")
+
+
+#: statement-termination kinds that are rank-divergence candidates
+#: (unlike "abort", which is exempt — see the GC009 rule notes)
+_EXIT_KINDS = ("return", "break", "continue")
+
+
+class _SyncAnalyzer:
+    """Per-graph sequence/uniformity analysis shared by GC009/GC010."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._seq_memo: Dict[FunctionInfo, _Seq] = {}
+        self._in_progress: Set[FunctionInfo] = set()
+        self._findings: Dict[FunctionInfo, List[Finding]] = {}
+        # per-callsite resolution memo: every Call is resolved up to
+        # three times (atom probe, summary splice, uniformity) and the
+        # loop dry-scan doubles it again — the graph's trees are
+        # stable for this analyzer's lifetime, so cache by node id
+        self._resolve_memo: Dict[Tuple[int, int],
+                                 List[FunctionInfo]] = {}
+        #: function NAMES carrying @contract.rank_uniform — used as a
+        #: fallback for call shapes the resolver cannot bind
+        #: (`snaps.sync_flag(...)` through an attribute of unknown
+        #: type).  Deliberately name-matched, like GC004's fallback.
+        self._uniform_names: Set[str] = {
+            fn.name for fn in graph.contracted("rank_uniform")}
+
+    # -- atoms ----------------------------------------------------------
+    def _resolve(self, fn: FunctionInfo,
+                 expr: ast.AST) -> List[FunctionInfo]:
+        key = (id(fn), id(expr))
+        hit = self._resolve_memo.get(key)
+        if hit is None:
+            hit = self.graph._resolve_callee_expr(fn, expr)
+            self._resolve_memo[key] = hit
+        return hit
+
+    def _atom_of(self, fn: FunctionInfo,
+                 call: ast.Call) -> Optional[str]:
+        """Host-collective name this call dispatches, or None."""
+        targets = self._resolve(fn, call.func)
+        for t in targets:
+            if t.module.rel == COLLECTIVE_ENTRY_MODULE \
+                    and t.name in HOST_COLLECTIVES:
+                return t.name
+        if not targets:
+            name = _terminal(_dotted(call.func))
+            if name in HOST_COLLECTIVES:
+                return name
+        return None
+
+    def _callee_seq(self, fn: FunctionInfo, call: ast.Call) -> _Seq:
+        """Spliced summary of a resolved non-atom package call."""
+        targets = self._resolve(fn, call.func)
+        if len(targets) != 1:
+            return ()
+        return self.seq(targets[0])
+
+    @staticmethod
+    def _own_calls(fn: FunctionInfo) -> List[ast.Call]:
+        from .callgraph import own_nodes
+        return [n for n in own_nodes(fn.node) if isinstance(n, ast.Call)]
+
+    # -- rank-uniformity of an expression -------------------------------
+    def _uniform(self, fn: FunctionInfo, expr: ast.AST,
+                 env: Dict[str, bool]) -> bool:
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.Name):
+            if expr.id in RANK_VARYING_NAMES:
+                return False
+            if expr.id in env:
+                return env[expr.id]
+            if expr.id.isupper() or expr.id in _UNIFORM_BUILTINS \
+                    or expr.id in _UNIFORM_ROOTS:
+                return True        # module constant / pure namespace
+            return self._is_param(fn, expr.id)
+        if isinstance(expr, ast.Attribute):
+            dotted = _dotted(expr)
+            if dotted is None:
+                return False
+            segs = dotted.split(".")
+            if any(s in RANK_VARYING_NAMES for s in segs):
+                return False
+            if segs[0] in ("config", "cfg") \
+                    or "config" in segs[1:-1] or "cfg" in segs[1:-1]:
+                return True        # fingerprint-synced configuration
+            return segs[-1] in RANK_UNIFORM_ATTRS
+        if isinstance(expr, ast.BoolOp):
+            return all(self._uniform(fn, v, env) for v in expr.values)
+        if isinstance(expr, ast.UnaryOp):
+            return self._uniform(fn, expr.operand, env)
+        if isinstance(expr, ast.BinOp):
+            return (self._uniform(fn, expr.left, env)
+                    and self._uniform(fn, expr.right, env))
+        if isinstance(expr, ast.Compare):
+            return (self._uniform(fn, expr.left, env)
+                    and all(self._uniform(fn, c, env)
+                            for c in expr.comparators))
+        if isinstance(expr, ast.IfExp):
+            return (self._uniform(fn, expr.test, env)
+                    and self._uniform(fn, expr.body, env)
+                    and self._uniform(fn, expr.orelse, env))
+        if isinstance(expr, ast.Subscript):
+            return (self._uniform(fn, expr.value, env)
+                    and self._uniform(fn, expr.slice, env))
+        if isinstance(expr, ast.Slice):
+            return all(self._uniform(fn, p, env)
+                       for p in (expr.lower, expr.upper, expr.step)
+                       if p is not None)
+        if isinstance(expr, ast.Tuple):
+            return all(self._uniform(fn, e, env) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self._uniform(fn, expr.value, env)
+        if isinstance(expr, ast.Call):
+            return self._uniform_call(fn, expr, env)
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            env2 = dict(env)
+            for gen in expr.generators:
+                it_u = self._uniform(fn, gen.iter, env2)
+                self._bind(gen.target, it_u, env2)
+                if not all(self._uniform(fn, c, env2)
+                           for c in gen.ifs):
+                    return False
+            if isinstance(expr, ast.DictComp):
+                return (self._uniform(fn, expr.key, env2)
+                        and self._uniform(fn, expr.value, env2))
+            return self._uniform(fn, expr.elt, env2)
+        # List/Dict/Set literals are mutable containers (a closure or
+        # signal handler can poke them rank-locally: cli.train's
+        # preempted flag); attribute soup: unknown.
+        return False
+
+    def _uniform_call(self, fn: FunctionInfo, call: ast.Call,
+                      env: Dict[str, bool]) -> bool:
+        dotted = _dotted(call.func)
+        if dotted in RANK_UNIFORM_CALLS:
+            return True
+        name = _terminal(dotted) if dotted else ""
+        if dotted is not None and dotted in _UNIFORM_BUILTINS:
+            return all(self._uniform(fn, a, env) for a in call.args)
+        targets = self._resolve(fn, call.func)
+        if targets:
+            return all(
+                ("rank_uniform" in t.contracts)
+                or (t.module.rel == COLLECTIVE_ENTRY_MODULE
+                    and t.name in HOST_COLLECTIVES)
+                for t in targets)
+        # unresolvable: name-matched fallbacks only
+        if name in HOST_COLLECTIVES or name in self._uniform_names:
+            return True
+        # method chained off a uniform value (alls.reshape, x.max, ...)
+        if isinstance(call.func, ast.Attribute) \
+                and self._uniform(fn, call.func.value, env):
+            return all(self._uniform(fn, a, env) for a in call.args)
+        return False
+
+    @staticmethod
+    def _is_param(fn: FunctionInfo, name: str) -> bool:
+        """Parameters default to rank-uniform: SPMD entry points pass
+        config-derived values; genuinely per-rank parameters are named
+        rank/process_index (RANK_VARYING_NAMES) by convention, which
+        wins above."""
+        node = fn.node
+        assert isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        a = node.args
+        names = [p.arg for p in (list(a.posonlyargs) + list(a.args)
+                                 + list(a.kwonlyargs))]
+        for extra in (a.vararg, a.kwarg):
+            if extra is not None:
+                names.append(extra.arg)
+        return name in names
+
+    # -- sequence construction + checking -------------------------------
+    def seq(self, fn: FunctionInfo) -> _Seq:
+        memo = self._seq_memo.get(fn)
+        if memo is not None:
+            return memo
+        if fn in self._in_progress:   # recursion back-edge
+            return ()
+        self._in_progress.add(fn)
+        findings: List[Finding] = []
+        env: Dict[str, bool] = {}
+        try:
+            body = list(getattr(fn.node, "body", []))
+            out, _term, _pending = self._stmts_seq(
+                fn, body, env, findings, loop_coll=False)
+            # pending early-exit divergences with NO collective after
+            # them are harmless: every rank that reaches a collective
+            # took the same prefix.  They drop here.
+        finally:
+            self._in_progress.discard(fn)
+        self._seq_memo[fn] = out
+        self._findings[fn] = findings
+        return out
+
+    def findings_for(self, fn: FunctionInfo) -> List[Finding]:
+        self.seq(fn)
+        return self._findings.get(fn, [])
+
+    def _expr_seq(self, fn: FunctionInfo, expr: Optional[ast.AST],
+                  ) -> _Seq:
+        """Atoms/summaries of every call inside one expression, in
+        EVALUATION order: post-order over the expression tree, so a
+        call nested in another call's arguments emits BEFORE the outer
+        call (Python evaluates arguments first — a lineno/col sort
+        would invert them and cry wolf on equivalent arms).  Lambdas
+        and nested defs that merely BUILD deferred callables
+        contribute nothing at this site."""
+        if expr is None:
+            return ()
+        out: List[object] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if isinstance(node, ast.Call):
+                atom = self._atom_of(fn, node)
+                if atom is not None:
+                    out.append(("c", atom))
+                else:
+                    out.extend(self._callee_seq(fn, node))
+
+        visit(expr)
+        return tuple(out)
+
+    def _assign_env(self, fn: FunctionInfo, stmt: ast.stmt,
+                    env: Dict[str, bool]) -> None:
+        if isinstance(stmt, ast.Assign):
+            u = self._uniform(fn, stmt.value, env)
+            for t in stmt.targets:
+                self._bind(t, u, env)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target,
+                       self._uniform(fn, stmt.value, env), env)
+        elif isinstance(stmt, ast.AugAssign):
+            u = (self._uniform(fn, stmt.target, env)
+                 and self._uniform(fn, stmt.value, env))
+            self._bind(stmt.target, u, env)
+
+    @staticmethod
+    def _bind(target: ast.AST, uniform: bool,
+              env: Dict[str, bool]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = uniform and \
+                target.id not in RANK_VARYING_NAMES
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                _SyncAnalyzer._bind(el, uniform, env)
+
+    def _stmts_seq(self, fn: FunctionInfo, stmts: List[ast.stmt],
+                   env: Dict[str, bool], findings: List[Finding],
+                   loop_coll: bool
+                   ) -> Tuple[_Seq, Optional[str],
+                              List[Tuple[int, str, str]]]:
+        """(sequence, termination, pending) of a statement list.
+        termination: None = falls through, "return"/"break"/
+        "continue" = the respective early exit, "abort" = raise /
+        log.fatal / sys.exit.
+        pending: rank-dependent early exits seen so far with no
+        collective after them YET — a later statement that emits one
+        converts each pending record into a GC009 finding (ranks that
+        exited early would skip it); pendings with no collective
+        downstream are harmless and drop at the function boundary."""
+        seq: List[object] = []
+        pending: List[Tuple[int, str, str]] = []
+        for stmt in stmts:
+            s, term, p = self._stmt_seq(fn, stmt, env, findings,
+                                        loop_coll)
+            self._convert_pending(fn, pending, s, findings)
+            seq.extend(s)
+            pending.extend(p)
+            if term is not None:
+                return tuple(seq), term, pending
+        return tuple(seq), None, pending
+
+    def _convert_pending(self, fn: FunctionInfo,
+                         pending: List[Tuple[int, str, str]], later: _Seq,
+                         findings: List[Finding]) -> None:
+        """Convert pending rank-dependent early exits into GC009
+        findings when `later` — a sequence the exiting ranks would
+        skip — emits collectives; clears the list in place."""
+        if not pending or not self._flatten_atoms(later):
+            return
+        for pline, cond, _kind in pending:
+            findings.append(Finding(
+                fn.module.rel, pline, "GC009",
+                "rank-dependent early exit `%s` in %s skips the later "
+                "collective sequence %s — exiting ranks would leave "
+                "their peers blocked inside it"
+                % (cond, fn.qual,
+                   self._render(tuple(self._flatten_events(later))))))
+        del pending[:]
+
+    @classmethod
+    def _flatten_events(cls, seq: _Seq) -> List[object]:
+        return [("c", a) for a in cls._flatten_atoms(seq)]
+
+    def _stmt_seq(self, fn: FunctionInfo, stmt: ast.stmt,
+                  env: Dict[str, bool], findings: List[Finding],
+                  loop_coll: bool
+                  ) -> Tuple[_Seq, Optional[str],
+                             List[Tuple[int, str, str]]]:
+        rel = fn.module.rel
+        line = getattr(stmt, "lineno", 1)
+        none: List[Tuple[int, str, str]] = []
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return (), None, none
+        if isinstance(stmt, ast.Return):
+            return self._expr_seq(fn, stmt.value), "return", none
+        if isinstance(stmt, ast.Break):
+            return (), "break", none
+        if isinstance(stmt, ast.Continue):
+            return (), "continue", none
+        if isinstance(stmt, ast.Raise):
+            return self._expr_seq(fn, stmt.exc), "abort", none
+        if isinstance(stmt, ast.Expr):
+            val = stmt.value
+            s = self._expr_seq(fn, val)
+            if isinstance(val, ast.Call) \
+                    and _is_abort_call(_dotted(val.func)):
+                return s, "abort", none
+            return s, None, none
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            s = self._expr_seq(fn, value)
+            self._assign_env(fn, stmt, env)
+            return s, None, none
+        if isinstance(stmt, ast.If):
+            return self._if_seq(fn, stmt, env, findings, loop_coll)
+        if isinstance(stmt, (ast.While, ast.For)):
+            return self._loop_seq(fn, stmt, env, findings)
+        if isinstance(stmt, ast.With):
+            seq: List[object] = []
+            for item in stmt.items:
+                seq.extend(self._expr_seq(fn, item.context_expr))
+            body, term, p = self._stmts_seq(fn, stmt.body, env,
+                                            findings, loop_coll)
+            return tuple(seq) + body, term, p
+        if isinstance(stmt, ast.Try):
+            seq_l: List[object] = []
+            pend: List[Tuple[int, str, str]] = []
+            body, term, p = self._stmts_seq(fn, stmt.body, env,
+                                            findings, loop_coll)
+            seq_l.extend(body)
+            pend.extend(p)
+            for h in stmt.handlers:
+                hseq, _ht, _hp = self._stmts_seq(fn, h.body, env,
+                                                 findings, loop_coll)
+                if hseq:
+                    findings.append(Finding(
+                        rel, getattr(h, "lineno", line), "GC009",
+                        "collective sequence %s inside an exception "
+                        "handler in %s — exception arrival is not "
+                        "rank-uniform, so the handler's collectives "
+                        "run on a subset of ranks"
+                        % (self._render(hseq), fn.qual)))
+            if term is None:
+                o, oterm, op = self._stmts_seq(fn, stmt.orelse, env,
+                                               findings, loop_coll)
+                # a pending early exit from the try body skips the
+                # orelse: a collective there converts it (same rule as
+                # the statement-list walk)
+                self._convert_pending(fn, pend, o, findings)
+                seq_l.extend(o)
+                pend.extend(op)
+                term = oterm
+            # NOTE: no conversion against finalbody — `finally` runs on
+            # the early-exiting rank too, so its collectives are not
+            # skipped; the pendings stay live for statements AFTER the
+            # try (which an early exit does skip)
+            fin, fterm, fp = self._stmts_seq(fn, stmt.finalbody, env,
+                                             findings, loop_coll)
+            seq_l.extend(fin)
+            pend.extend(fp)
+            return tuple(seq_l), fterm or term, pend
+        if isinstance(stmt, (ast.Import, ast.ImportFrom, ast.Pass,
+                             ast.Global, ast.Nonlocal, ast.Assert,
+                             ast.Delete)):
+            return (), None, none
+        return (), None, none
+
+    def _if_seq(self, fn: FunctionInfo, stmt: ast.If,
+                env: Dict[str, bool], findings: List[Finding],
+                loop_coll: bool
+                ) -> Tuple[_Seq, Optional[str],
+                           List[Tuple[int, str, str]]]:
+        rel = fn.module.rel
+        line = getattr(stmt, "lineno", 1)
+        test_seq = self._expr_seq(fn, stmt.test)
+        uniform = self._uniform(fn, stmt.test, env)
+        pend: List[Tuple[int, str, str]] = []
+        pre_env = dict(env)
+        a_seq, a_term, ap = self._stmts_seq(fn, stmt.body, env,
+                                            findings, loop_coll)
+        b_seq, b_term, bp = self._stmts_seq(fn, stmt.orelse, env,
+                                            findings, loop_coll)
+        pend.extend(ap)
+        pend.extend(bp)
+        if not uniform:
+            # a name assigned under a rank-LOCAL condition is rank-
+            # local afterwards no matter how uniform the assigned value
+            # looked (whether the assignment ran depends on the rank) —
+            # without this, `if rank == 0: flag = True` launders flag.
+            # Uniform-test branches keep the last-assignment-wins rule:
+            # the vote-then-branch idiom relies on it.
+            for name, val in list(env.items()):
+                if pre_env.get(name) is not val:
+                    env[name] = False
+        if not uniform:
+            cond = ast.unparse(stmt.test) if hasattr(ast, "unparse") \
+                else "<condition>"
+            arms = [(a_seq, a_term), (b_seq, b_term)]
+            live = [(s, t) for s, t in arms if t != "abort"]
+            for s, t in arms:
+                if t == "abort" and s:
+                    findings.append(Finding(
+                        rel, line, "GC009",
+                        "collective sequence %s on an aborting arm of "
+                        "the rank-dependent branch `%s` in %s — a "
+                        "subset of ranks would enter the collective "
+                        "before dying" % (self._render(s), cond,
+                                          fn.qual)))
+            if len(live) == 2 and live[0][0] != live[1][0]:
+                findings.append(Finding(
+                    rel, line, "GC009",
+                    "branch arms emit different collective sequences "
+                    "(%s vs %s) under the rank-dependent condition "
+                    "`%s` in %s — every rank must execute the "
+                    "identical collective sequence (prove the "
+                    "condition rank-uniform via vote_any / synced "
+                    "config / @contract.rank_uniform, or lift the "
+                    "collectives out of the branch)"
+                    % (self._render(live[0][0]),
+                       self._render(live[1][0]), cond, fn.qual)))
+            exits = [t for _, t in live if t in _EXIT_KINDS]
+            if exits and len(exits) != len(live):
+                if loop_coll:
+                    findings.append(Finding(
+                        rel, line, "GC010",
+                        "rank-dependent early exit `%s` inside a "
+                        "collective-emitting loop in %s — ranks would "
+                        "run different collective counts" % (cond,
+                                                             fn.qual)))
+                else:
+                    # divergence only matters if a collective follows:
+                    # the enclosing walk resolves or drops it, honoring
+                    # what each exit kind actually skips
+                    pend.append((line, cond, exits[0]))
+        # summary event + termination
+        if a_seq == b_seq and a_term == b_term:
+            ev: _Seq = a_seq
+            term = a_term
+        else:
+            ev = (("br", (a_seq, a_term), (b_seq, b_term)),) \
+                if (a_seq or b_seq) else ()
+            if a_term is not None and b_term is not None:
+                kinds = [t for t in (a_term, b_term)
+                         if t in _EXIT_KINDS]
+                term: Optional[str] = kinds[0] if kinds else "abort"
+            else:
+                term = None
+        return test_seq + tuple(ev), term, pend
+
+    def _loop_seq(self, fn: FunctionInfo, stmt: ast.stmt,
+                  env: Dict[str, bool], findings: List[Finding]
+                  ) -> Tuple[_Seq, Optional[str],
+                             List[Tuple[int, str, str]]]:
+        rel = fn.module.rel
+        line = getattr(stmt, "lineno", 1)
+        if isinstance(stmt, ast.While):
+            head = stmt.test
+        else:
+            assert isinstance(stmt, ast.For)
+            head = stmt.iter
+        head_seq = self._expr_seq(fn, head)
+        uniform = self._uniform(fn, head, env)
+        if isinstance(stmt, ast.For):
+            self._bind(stmt.target, uniform, env)
+        # dry scan: does the body emit collectives at all?  (needed
+        # before walking, so rank-local exits inside get GC010)
+        probe: List[Finding] = []
+        body_probe, _, _ = self._stmts_seq(fn, stmt.body, dict(env),
+                                           probe, loop_coll=False)
+        has_coll = bool(self._flatten_atoms(body_probe))
+        body_seq, _term, bp = self._stmts_seq(fn, stmt.body, env,
+                                              findings,
+                                              loop_coll=has_coll)
+        tail_seq, tail_term, tp = self._stmts_seq(fn, stmt.orelse, env,
+                                                  findings,
+                                                  loop_coll=False)
+        # what each body exit kind skips: `return` skips the loop's
+        # else-clause AND everything after the loop; `break` skips the
+        # else-clause only; `continue` skips nothing outside its own
+        # iteration.  (Exit-divergences in COLLECTIVE loops already
+        # became GC010 via loop_coll.)
+        live_after = [q for q in bp if q[2] == "return"]
+        skip_else = [q for q in bp if q[2] in ("return", "break")]
+        self._convert_pending(fn, skip_else, tail_seq, findings)
+        # anything converted against the else is done; unconverted
+        # returns stay live for the caller's statement walk
+        live_after = [q for q in live_after if q in skip_else]
+        if isinstance(stmt, ast.While) and uniform:
+            # a `while` head re-evaluates every iteration: the body's
+            # LAST assignments feed the next test, so a body that
+            # leaves the condition rank-local (e.g. drops the re-sync)
+            # diverges from iteration 2 on even when entry was uniform
+            uniform = self._uniform(fn, head, env)
+        if has_coll and not uniform:
+            cond = ast.unparse(head) if hasattr(ast, "unparse") \
+                else "<head>"
+            findings.append(Finding(
+                rel, line, "GC010",
+                "collective sequence %s inside a loop whose trip "
+                "count depends on `%s`, which is not provably "
+                "rank-uniform (at entry or after the body's "
+                "reassignments), in %s — ranks would run different "
+                "collective counts; derive the bound from synced "
+                "config/collective results or hoist the collective"
+                % (self._render(body_seq), cond, fn.qual)))
+        ev: _Seq = (("loop", body_seq),) if body_seq else ()
+        return head_seq + ev + tail_seq, tail_term, live_after + tp
+
+    # -- rendering -------------------------------------------------------
+    @classmethod
+    def _flatten_atoms(cls, seq: _Seq) -> List[str]:
+        out: List[str] = []
+        for ev in seq:
+            assert isinstance(ev, tuple)
+            if ev[0] == "c":
+                out.append(str(ev[1]))
+            elif ev[0] == "br":
+                for arm in (ev[1], ev[2]):
+                    out.extend(cls._flatten_atoms(arm[0]))
+            elif ev[0] == "loop":
+                out.extend(cls._flatten_atoms(ev[1]))
+        return out
+
+    @classmethod
+    def _render(cls, seq: _Seq) -> str:
+        atoms = cls._flatten_atoms(seq)
+        return "[%s]" % ", ".join(atoms) if atoms else "[]"
+
+
+# ---------------------------------------------------------------------------
+# GC009 / GC010 — whole-package sweep
+# ---------------------------------------------------------------------------
+
+def check_collective_sequences(graph: CallGraph,
+                               findings: List[Finding]) -> None:
+    analyzer = _SyncAnalyzer(graph)
+    for rel in sorted(graph.modules):
+        mod = graph.modules[rel]
+        for fn in mod.all_functions:
+            findings.extend(analyzer.findings_for(fn))
+
+
+# ---------------------------------------------------------------------------
+# GC011 — single collective entry point
+# ---------------------------------------------------------------------------
+
+def check_collective_entry(graph: CallGraph,
+                           findings: List[Finding]) -> None:
+    for rel in sorted(graph.modules):
+        if rel == COLLECTIVE_ENTRY_MODULE:
+            continue            # the sanctioned site
+        mod = graph.modules[rel]
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, what: str) -> None:
+            if (line, what) in seen:
+                return
+            seen.add((line, what))
+            findings.append(Finding(
+                rel, line, "GC011",
+                "%s outside %s — blocking host collectives must route "
+                "through the parallel/dist.py wrappers so they "
+                "inherit call_with_deadline (NetworkError instead of "
+                "an indefinite hang) and the runtime collective trace"
+                % (what, COLLECTIVE_ENTRY_MODULE)))
+
+        for node in ast.walk(mod.tree):
+            line = getattr(node, "lineno", 1)
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if "multihost_utils" in alias.name \
+                            or alias.name == "jax.distributed" \
+                            or alias.name.startswith("jax.distributed."):
+                        emit(line, "import of %s" % alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if "multihost_utils" in m:
+                    emit(line, "import from %s" % m)
+                elif m in ("jax", "jax.experimental"):
+                    for alias in node.names:
+                        if alias.name in ("multihost_utils",
+                                          "distributed"):
+                            emit(line, "import of %s.%s"
+                                 % (m, alias.name))
+                elif m == "jax.distributed" \
+                        or m.startswith("jax.distributed."):
+                    emit(line, "import from %s" % m)
+            elif isinstance(node, ast.Attribute):
+                dotted = _dotted(node)
+                if dotted is None:
+                    continue
+                if dotted.startswith("jax.distributed.") \
+                        or "multihost_utils." in dotted:
+                    emit(line, "direct use of %s" % dotted)
+
+
+# ---------------------------------------------------------------------------
+# Static model exports (the runtime-trace test cross-checks these)
+# ---------------------------------------------------------------------------
+
+def collective_sites(graph: CallGraph) -> Set[Tuple[str, int, str]]:
+    """Every statically-resolved host-collective call site:
+    {(module rel, line, collective name)}.  The 2-process runtime
+    trace test asserts every traced callsite inside the package is one
+    of these — a dynamically-dispatched collective the static model
+    cannot see (a hook like GBDT.stop_sync) would fail the test and
+    must be registered."""
+    analyzer = _SyncAnalyzer(graph)
+    out: Set[Tuple[str, int, str]] = set()
+    for rel in sorted(graph.modules):
+        mod = graph.modules[rel]
+        for fn in mod.all_functions:
+            for call in analyzer._own_calls(fn):
+                atom = analyzer._atom_of(fn, call)
+                if atom is not None:
+                    out.add((rel, getattr(call, "lineno", 0), atom))
+    return out
+
+
+def run_graftsync_graph(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    check_collective_sequences(graph, findings)
+    check_collective_entry(graph, findings)
+    return findings

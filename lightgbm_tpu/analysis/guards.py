@@ -222,3 +222,12 @@ if _pytest is not None:
         compile_budget context manager as a fixture, so tests declare
         compile budgets without importing the analysis package."""
         return compile_budget
+
+    @_pytest.fixture
+    def collective_trace() -> object:
+        """`with collective_trace() as events: ...` — the per-rank
+        host-collective ring buffer (parallel/dist.trace_collectives)
+        as a fixture, same pattern as xla_guard.  Each event is a
+        (name, shape, dtype, callsite) CollectiveEvent."""
+        from ..parallel.dist import trace_collectives
+        return trace_collectives

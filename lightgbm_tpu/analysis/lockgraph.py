@@ -1,0 +1,344 @@
+"""lockgraph — lock-order and lock-latency analysis (GC012).
+
+The serving fleet runs eleven lock sites across five classes; its two
+standing disciplines have so far been comment-enforced:
+
+  * acquisition ORDER is a partial order (fleet.py takes `_load_lock`
+    then `_lock`, never the reverse) — an inverted nesting anywhere
+    creates a deadlock window that no single-threaded test can see;
+  * hot-path locks are FAST: cold model loads, device dispatch and
+    socket I/O happen OUTSIDE the pool/metrics/breaker locks, so a
+    slow operation can never stall every serving thread behind a lock
+    (fleet.py's loads-outside-pool-lock discipline).
+
+GC012 machine-checks both.  The lock-acquisition graph is built from
+`with self._lock:` syntax (locks named per owning class, with
+module-global singletons like faults._REG resolved to their class) plus
+the existing `@contract.locked_by` declarations; edges are lexical
+nesting and calls made while holding a lock whose transitive closure
+acquires another lock.  Findings:
+
+  * a CYCLE in the acquisition graph (potential deadlock);
+  * a blocking operation — a call to a contracts.BLOCKING_FUNCTIONS
+    entry (model parse+warm, device dispatch, batcher submit) or a
+    blocking attribute call (socket recv/accept/connect, sleep,
+    subprocess communicate) — reached while holding a lock not listed
+    in contracts.LOCK_ALLOWED_BLOCKING.  `.wait()` on the held
+    condition variable is exempt (releasing the lock is the point).
+
+Scope: `with` sites in serving/ and resilience/ (the threaded
+subsystems); closures are computed package-wide so a blocking call two
+modules away is still attributed to the lock held at the top.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (CallGraph, FunctionInfo, _dotted, _lockish_name,
+                        own_nodes)
+from .contracts import (BLOCKING_ATTR_CALLS, BLOCKING_FUNCTIONS,
+                        LOCK_ALLOWED_BLOCKING)
+from .graftlint import Finding
+
+__jax_free__ = True
+
+LOCK_RULES: Dict[str, str] = {
+    "GC012": "lock-order",
+}
+
+#: module prefixes whose `with <lock>` sites are checked
+_SCOPE_PREFIXES = ("serving/", "resilience/")
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.startswith(p) for p in _SCOPE_PREFIXES)
+
+
+class _BlockingOp:
+    def __init__(self, qual: str, line: int, what: str,
+                 receiver_lock: Optional[str]):
+        self.qual = qual          # function the op lives in
+        self.line = line
+        self.what = what          # human-readable operation
+        self.receiver_lock = receiver_lock   # lockish receiver of .wait
+
+
+class _LockAnalyzer:
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._global_types = self._module_global_types()
+        self._acq_memo: Dict[FunctionInfo, Set[str]] = {}
+        self._blk_memo: Dict[FunctionInfo, List[_BlockingOp]] = {}
+
+    # -- lock node naming ------------------------------------------------
+    def _module_global_types(self) -> Dict[Tuple[str, str], str]:
+        """{(module rel, global name): class name} for module-level
+        `NAME = ClassName(...)` singletons (faults._REG)."""
+        out: Dict[Tuple[str, str], str] = {}
+        for rel, mod in self.graph.modules.items():
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                v = node.value
+                if isinstance(t, ast.Name) and isinstance(v, ast.Call):
+                    cls = _dotted(v.func)
+                    if cls is not None and "." not in cls \
+                            and cls in mod.classes:
+                        out[(rel, t.id)] = cls
+        return out
+
+    def lock_node(self, fn: FunctionInfo,
+                  ctx_expr: ast.AST) -> Optional[str]:
+        """Class-qualified lock name for one with-context expression
+        ('self._lock' in a ModelFleet method -> 'ModelFleet._lock'),
+        or None when it is not a lock or its owner is unknown."""
+        attr = _lockish_name(ctx_expr)
+        if attr is None:
+            return None
+        expr = ctx_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        if parts[0] == "self" and fn.cls is not None and len(parts) == 2:
+            return "%s.%s" % (fn.cls.name, parts[1])
+        if len(parts) == 2:
+            cls = self._global_types.get((fn.module.rel, parts[0]))
+            if cls is not None:
+                return "%s.%s" % (cls, parts[1])
+        return None
+
+    # -- transitive summaries ---------------------------------------------
+    def acquired_closure(self, fn: FunctionInfo) -> Set[str]:
+        """Lock nodes acquired by fn or anything it reaches."""
+        memo = self._acq_memo.get(fn)
+        if memo is not None:
+            return memo
+        out: Set[str] = set()
+        for reached in self.graph.reach([fn]):
+            for node in own_nodes(reached.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ln = self.lock_node(reached, item.context_expr)
+                        if ln is not None:
+                            out.add(ln)
+        self._acq_memo[fn] = out
+        return out
+
+    @staticmethod
+    def classify_blocking(call: ast.Call
+                          ) -> Optional[Tuple[str, Optional[str]]]:
+        """(human-readable op, lockish `.wait` receiver or None) when
+        this call is a blocking operation; None otherwise.  The ONE
+        classifier behind both the direct under-lock check and the
+        transitive closure — the two must never drift.  notify/
+        notify_all never block; `.wait` blocks regardless of receiver
+        (the caller exempts only a wait on the HELD condition
+        variable, which releases the lock)."""
+        dotted = _dotted(call.func)
+        term = dotted.rpartition(".")[2] if dotted else ""
+        if dotted == "time.sleep" or (
+                term in BLOCKING_ATTR_CALLS
+                and isinstance(call.func, ast.Attribute)):
+            if term in ("notify", "notify_all"):
+                return None
+            recv = None
+            if term == "wait" and isinstance(call.func, ast.Attribute):
+                recv = _lockish_name(call.func.value)
+            return "%s(...)" % (dotted or ".%s" % term), recv
+        return None
+
+    def _own_blocking(self, fn: FunctionInfo) -> List[_BlockingOp]:
+        out: List[_BlockingOp] = []
+        for node in own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            op = self.classify_blocking(node)
+            if op is not None:
+                out.append(_BlockingOp(
+                    fn.qual, getattr(node, "lineno", 1), op[0], op[1]))
+        return out
+
+    def blocking_closure(self, fn: FunctionInfo) -> List[_BlockingOp]:
+        """Blocking evidence anywhere in fn's transitive call closure,
+        including fn itself being a declared blocking primitive."""
+        memo = self._blk_memo.get(fn)
+        if memo is not None:
+            return memo
+        out: List[_BlockingOp] = []
+        for reached in self.graph.reach([fn]):
+            if reached.qual in BLOCKING_FUNCTIONS:
+                out.append(_BlockingOp(
+                    reached.qual, getattr(reached.node, "lineno", 1),
+                    "declared blocking primitive %s" % reached.qual,
+                    None))
+            out.extend(self._own_blocking(reached))
+        self._blk_memo[fn] = out
+        return out
+
+
+def _with_calls(with_node: ast.With) -> List[ast.Call]:
+    """Calls lexically inside a with block (nested defs/lambdas are
+    deferred and excluded)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = []
+    for stmt in with_node.body:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _inner_withs(with_node: ast.With) -> List[ast.With]:
+    out: List[ast.With] = []
+    stack: List[ast.AST] = []
+    for stmt in with_node.body:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.With):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_lock_order(graph: CallGraph,
+                     findings: List[Finding]) -> None:
+    an = _LockAnalyzer(graph)
+    # edges: held-lock -> acquired-lock, with one evidence site each
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, rel: str, line: int,
+                 how: str) -> None:
+        if a != b:
+            edges.setdefault((a, b), (rel, line, how))
+
+    for rel in sorted(graph.modules):
+        if not _in_scope(rel):
+            continue
+        mod = graph.modules[rel]
+        for fn in mod.all_functions:
+            for node in own_nodes(fn.node):
+                if not isinstance(node, ast.With):
+                    continue
+                held = [an.lock_node(fn, item.context_expr)
+                        for item in node.items]
+                held = [h for h in held if h is not None]
+                if not held:
+                    continue
+                line = getattr(node, "lineno", 1)
+                for lock in held:
+                    # lexically nested acquisitions
+                    for inner in _inner_withs(node):
+                        for item in inner.items:
+                            ln = an.lock_node(fn, item.context_expr)
+                            if ln is not None:
+                                add_edge(lock, ln, rel,
+                                         getattr(inner, "lineno", line),
+                                         "nested `with` in %s" % fn.qual)
+                    lock_attr = lock.rpartition(".")[2]
+                    allowed = lock in LOCK_ALLOWED_BLOCKING
+                    for call in _with_calls(node):
+                        cline = getattr(call, "lineno", line)
+                        targets = graph._resolve_callee_expr(
+                            fn, call.func)
+                        for t in targets:
+                            for ln in an.acquired_closure(t):
+                                add_edge(lock, ln, rel, cline,
+                                         "call to %s under %s in %s"
+                                         % (t.qual, lock, fn.qual))
+                        if allowed:
+                            continue
+                        # direct blocking operation under the lock —
+                        # the SAME classifier the transitive closure
+                        # uses, so the two checks cannot drift.  A
+                        # .wait() on the held cv releases the lock and
+                        # is exempt; on anything else (an Event,
+                        # another cv) it blocks WITH the lock held.
+                        op = an.classify_blocking(call)
+                        if op is not None:
+                            what, recv = op
+                            if recv == lock_attr:
+                                continue
+                            findings.append(Finding(
+                                rel, cline, "GC012",
+                                "%s while holding %s in %s — a "
+                                "blocking operation under a fast lock "
+                                "stalls every thread behind it; move "
+                                "it outside the lock or register the "
+                                "lock in contracts.LOCK_ALLOWED_"
+                                "BLOCKING with a justification"
+                                % (what, lock, fn.qual)))
+                            continue
+                        # blocking reached through a resolved callee
+                        for t in targets:
+                            for op in an.blocking_closure(t):
+                                if op.receiver_lock == lock_attr:
+                                    continue   # wait on the held cv
+                                findings.append(Finding(
+                                    rel, cline, "GC012",
+                                    "call to %s while holding %s in "
+                                    "%s reaches a blocking operation "
+                                    "(%s at %s:%d) — cold loads/"
+                                    "dispatch/socket I/O must run "
+                                    "outside fast locks (fleet.py's "
+                                    "loads-outside-pool-lock "
+                                    "discipline); or register the "
+                                    "lock in contracts.LOCK_ALLOWED_"
+                                    "BLOCKING"
+                                    % (t.qual, lock, fn.qual, op.what,
+                                       op.qual, op.line)))
+                                break   # one evidence line per callee
+
+    # cycle detection over the acquisition graph
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    for outs in adj.values():
+        outs.sort()
+    reported: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in adj.get(node, []):
+            if nxt == start:
+                cycle = path + [nxt]
+                key = tuple(sorted(set(cycle)))
+                if key in reported:
+                    continue
+                reported.add(key)
+                rel, line, how = edges[(path[-1], nxt)]
+                findings.append(Finding(
+                    rel, line, "GC012",
+                    "lock acquisition cycle %s — two threads taking "
+                    "these locks in opposite orders deadlock; pick "
+                    "ONE order (evidence for the closing edge: %s)"
+                    % (" -> ".join(cycle), how)))
+            elif nxt not in on_path:
+                # bound the walk: cycles in this graph are tiny
+                if len(path) < 6:
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+
+
+def run_lockgraph_graph(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    check_lock_order(graph, findings)
+    return findings

@@ -289,6 +289,95 @@ def _t_bare_sidecar_savez(src: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# spmd_collectives — rank-divergent collective sequences (graftsync)
+# ---------------------------------------------------------------------------
+
+def _t_rank_gated_vote(src: str) -> str:
+    return _replace_once(
+        src,
+        "        from ..parallel.dist import vote_any\n"
+        "        return vote_any(flag)\n",
+        "        from ..parallel.dist import vote_any\n"
+        "        if self.rank == 0:  # seeded violation\n"
+        "            return vote_any(flag)\n"
+        "        return flag\n",
+        what="rank-gated vote_any into sync_flag")
+
+
+_AGREE_GATHER = (
+    "        from ..parallel.dist import process_allgather\n"
+    "        alls = process_allgather(\n"
+    "            np.array([iteration], dtype=np.int64)).reshape(-1)\n")
+
+
+def _t_branch_reordered_allgather(src: str) -> str:
+    return _replace_once(
+        src, _AGREE_GATHER,
+        "        from ..parallel.dist import process_allgather, vote_any\n"
+        "        if self.rank % 2 == 0:  # seeded violation\n"
+        "            vote_any(False)\n"
+        "            alls = process_allgather(\n"
+        "                np.array([iteration], dtype=np.int64)"
+        ").reshape(-1)\n"
+        "        else:\n"
+        "            alls = process_allgather(\n"
+        "                np.array([iteration], dtype=np.int64)"
+        ").reshape(-1)\n"
+        "            vote_any(False)\n",
+        what="rank-reordered allgather arms into _agree")
+
+
+def _t_collective_in_rank_loop(src: str) -> str:
+    return _insert_before(
+        src,
+        "        alls = process_allgather(\n",
+        "        for _ in range(self.rank):  # seeded violation\n"
+        "            process_allgather(np.zeros(1, dtype=np.int64))\n",
+        what="collective inside a rank-local loop in _agree")
+
+
+def _t_direct_multihost_in_write(src: str) -> str:
+    return _insert_after(
+        src,
+        '        faultpoint("checkpoint.write")\n',
+        "        from jax.experimental import multihost_utils"
+        "  # seeded violation\n"
+        '        multihost_utils.sync_global_devices("snapshot")\n',
+        what="direct multihost_utils call into SnapshotManager.write")
+
+
+# ---------------------------------------------------------------------------
+# lock_order — inverted acquisition / blocking under the pool lock
+# ---------------------------------------------------------------------------
+
+def _t_inverted_lock_order(src: str) -> str:
+    return _replace_once(
+        src,
+        "        fresh = (loader or self._load_fresh)(path)\n"
+        "        with self._lock:\n"
+        "            self._registered[path] = True\n",
+        "        with self._lock:  # seeded violation\n"
+        "            with self._load_lock:\n"
+        "                fresh = (loader or self._load_fresh)(path)\n"
+        "        with self._lock:\n"
+        "            self._registered[path] = True\n",
+        what="inverted _lock/_load_lock nesting into ModelFleet.reload")
+
+
+def _t_cold_load_under_pool_lock(src: str) -> str:
+    return _replace_once(
+        src,
+        "            fresh = self._load_fresh(path)\n"
+        "            with self._lock:\n"
+        "                self._pool[path] = fresh\n",
+        "            with self._lock:\n"
+        "                fresh = self._load_fresh(path)"
+        "  # seeded violation\n"
+        "                self._pool[path] = fresh\n",
+        what="cold load moved under the pool lock in ModelFleet._load")
+
+
+# ---------------------------------------------------------------------------
 # The corpus
 # ---------------------------------------------------------------------------
 
@@ -417,6 +506,45 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "a bare np.savez of the rows sidecar outside the atomic helper "
        "— a truncated sidecar desyncs the cluster's row partition",
        _t_bare_sidecar_savez),
+
+    _m("rank-gated-vote-any", "spmd_collectives",
+       "resilience/snapshot.py", "GC009", "resilience/snapshot.py",
+       "vote_any",
+       "vote_any behind `if self.rank == 0` in sync_flag — rank 0 "
+       "enters the collective alone and blocks until the deadline",
+       _t_rank_gated_vote),
+    _m("branch-reordered-allgather", "spmd_collectives",
+       "resilience/snapshot.py", "GC009", "resilience/snapshot.py",
+       "different collective sequences",
+       "the SAME collective set in a different ORDER per rank parity "
+       "— the sequence-sensitive check catches what a set comparison "
+       "(GC005-style) cannot",
+       _t_branch_reordered_allgather),
+    _m("collective-in-rank-local-loop", "spmd_collectives",
+       "resilience/snapshot.py", "GC010", "resilience/snapshot.py",
+       "range(self.rank)",
+       "an allgather inside `for _ in range(self.rank)` — every rank "
+       "runs a different collective count and the pool wedges",
+       _t_collective_in_rank_loop),
+    _m("direct-multihost-in-snapshot", "spmd_collectives",
+       "resilience/snapshot.py", "GC011", "resilience/snapshot.py",
+       "multihost_utils",
+       "a bare multihost_utils call in SnapshotManager.write — it "
+       "bypasses dist.py, so no deadline wrapping and no trace",
+       _t_direct_multihost_in_write),
+
+    _m("inverted-lock-order-in-fleet", "lock_order",
+       "serving/fleet.py", "GC012", "serving/fleet.py", "cycle",
+       "reload nests _load_lock under _lock while _load nests _lock "
+       "under _load_lock — a deadlock window between /reload and a "
+       "cold-miss request",
+       _t_inverted_lock_order),
+    _m("cold-load-under-pool-lock", "lock_order",
+       "serving/fleet.py", "GC012", "serving/fleet.py", "_load_fresh",
+       "the cold parse+warm moved under the POOL lock — every warm "
+       "hit stalls behind a multi-second model load (the discipline "
+       "fleet.py's comments used to carry, now machine-checked)",
+       _t_cold_load_under_pool_lock),
 )
 
 

@@ -26,6 +26,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..analysis.contracts import contract
 from ..config import Config
 from ..resilience.atomic import atomic_write_bytes
 
@@ -158,13 +159,19 @@ def load_manifest(dirpath: str,
         return Manifest.from_json(f.read().decode("utf-8", "replace"))
 
 
+@contract.rank_uniform
 def is_manifest_path(path: str) -> bool:
     """True when `path` names an ingest directory (or its manifest.json
     directly) — the load_dataset routing probe.  A directory holding
     only plan/pack artifacts (a KILLED ingest that never committed its
     manifest) routes here too, so the loader's 're-run task=ingest'
     diagnostic fires instead of the text parser choking on a
-    directory."""
+    directory.
+
+    @contract.rank_uniform: the probe answers off the shared dataset
+    artifact every rank points data= at — ranks disagreeing would mean
+    ranks were handed different datasets, which the config fingerprint
+    cannot catch but the bin-mapper agreement would."""
     if os.path.basename(path) == MANIFEST_NAME:
         return os.path.isfile(path)
     if not os.path.isdir(path):

@@ -26,10 +26,12 @@ __jax_free__ = True
 
 import dataclasses
 import os
+import sys
 from typing import List, Optional
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..resilience.atomic import (IntegrityError, atomic_writer, read_npz,
                                  verify_file, write_npz)
 from ..utils import log
@@ -902,6 +904,35 @@ def _rank_cache_matches(cache: str, filename: str,
         return "unreadable sidecar (%s)" % ex
 
 
+@contract.rank_uniform
+def _agree_cache_choice(local_ok: bool, cache: str) -> bool:
+    """Collective binary-cache decision for multi-PROCESS runs: use
+    caches only when EVERY rank holds a usable one (one vote_any per
+    load — the same cost class as the bin-mapper allgather the cache
+    skips).  Single-process (or jax never imported: the jax-free
+    lanes) returns the local answer unchanged.
+
+    @contract.rank_uniform: the return value is vote_any-agreed, so
+    graftsync accepts the cache-vs-text routing branch as uniform."""
+    jax = sys.modules.get("jax")
+    multi = False
+    if jax is not None:
+        try:
+            multi = jax.process_count() > 1
+        except Exception:  # backend not initialized: single process
+            multi = False
+    if not multi:
+        return local_ok
+    from ..parallel.dist import vote_any
+    any_missing = vote_any(not local_ok)
+    if any_missing and local_ok:
+        log.warning("Ignoring binary cache %s: another rank has no "
+                    "usable cache, and the bin-finding pass is "
+                    "collective — all ranks load from text together"
+                    % cache)
+    return local_ok and not any_missing
+
+
 def load_dataset(filename: str, config: Config,
                  reference: Optional[Dataset] = None,
                  rank: int = 0, num_shards: int = 1) -> Dataset:
@@ -971,8 +1002,17 @@ def load_dataset(filename: str, config: Config,
         # as-is; otherwise the lottery subsample applies below
         cache = global_cache
         shard_from_global = not config.is_pre_partition
-    if (reference is None and config.enable_load_from_binary_file
-            and os.path.isfile(cache)):
+    use_cache = (reference is None and config.enable_load_from_binary_file
+                 and os.path.isfile(cache))
+    # Multi-process: the cache decision must be COLLECTIVE.  A rank
+    # whose cache file is present would skip the text two-round pass —
+    # and with is_parallel_find_bin the cache-less peers would block
+    # inside the distributed-FindBin allgather waiting for it (the
+    # divergence graftsync GC009 flags).  All ranks use caches, or none
+    # do; either way the loaded bins are byte-identical (the cache IS
+    # the text path's result, pinned by the lottery-parity tests).
+    use_cache = _agree_cache_choice(use_cache, cache)
+    if use_cache:
         try:
             ds = _load_binary(cache)
             n_global = 0

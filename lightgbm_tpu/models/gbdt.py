@@ -1396,6 +1396,7 @@ class GBDT:
                 np.packbits(self.bag_masks[cls]))
         return self._bag_dev_packed[cls]
 
+    @contract.rank_uniform
     def _can_fuse(self) -> bool:
         """The fused single-dispatch iteration covers the single-class
         path with a jax-traceable objective (regression/binary) on the
@@ -1417,6 +1418,7 @@ class GBDT:
                 and getattr(self.objective, "jax_traceable", False)
                 and self.objective.fused_key() is not None)
 
+    @contract.rank_uniform
     def _can_fuse_multi(self) -> bool:
         """The multiclass fused iteration (_make_fused_step_multi):
         serial learner OR tree_learner=data (the shard_map variant,
@@ -1659,6 +1661,7 @@ class GBDT:
         fused steps already removed)."""
         return self._can_fuse() or self._can_fuse_multi()
 
+    @contract.rank_uniform
     def _plan_segment(self, max_iters: int, is_eval: bool) -> int:
         """K for the next dispatch: min(iter_batch, metric boundary,
         early-stop check, re-bagging epoch boundary, re-sort cadence,
@@ -1696,6 +1699,7 @@ class GBDT:
         # bank to fit any k before the dispatch)
         return max(k, 1)
 
+    @contract.rank_uniform
     def train_segment(self, max_iters: int,
                       is_eval: bool = True) -> "Tuple[bool, int]":
         """Train up to max_iters boosting iterations, batching
@@ -1822,6 +1826,7 @@ class GBDT:
         w = -(-max(bound, 1) // unit) * unit
         return w if w < self.n_pad else 0
 
+    @contract.rank_uniform
     def _bag_compact_rows(self) -> int:
         """The active compacted window (rows per device shard under the
         sharded fused step; all rows otherwise).  0 = masked path."""
@@ -1829,6 +1834,7 @@ class GBDT:
             self._bag_window = self._compute_bag_window()
         return 0 if self._bag_overflowed else self._bag_window
 
+    @contract.rank_uniform
     def _bag_window_overflow(self) -> bool:
         """Host-side guard for the sharded per-shard window: True when
         the current draw's per-shard in-bag union count exceeds it
@@ -2419,6 +2425,7 @@ class GBDT:
     # globally reduced, so decisions agree; this is the hard guarantee)
     stop_sync = None
 
+    @contract.rank_uniform
     def _sync_stop(self, stop: bool) -> bool:
         if self.stop_sync is not None:
             return bool(self.stop_sync(bool(stop)))
@@ -3088,6 +3095,7 @@ class DART(GBDT):
         # iteration
         self._flush_every = 16 if self._can_fuse_dart() else 1
 
+    @contract.rank_uniform
     def _can_fuse_dart(self) -> bool:
         # objective check first: prediction-only instances return before
         # GBDT.__init__ sets grower/hist attributes

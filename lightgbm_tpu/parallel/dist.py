@@ -12,6 +12,15 @@ socket/MPI linkers, ~1,150 LoC) has no equivalent here by design.
 
 Host-side (numpy) exchanges — bin mappers at load time — go through
 process_allgather (jax.experimental.multihost_utils).
+
+This module is the ONE sanctioned multihost entry point (graftsync
+GC011): every wrapper funnels through process_allgather, so every
+host collective inherits the per-collective deadline AND the runtime
+collective trace.  trace_collectives() captures a per-rank ring
+buffer of (name, shape, dtype, callsite) events — off by default,
+enabled by the 2-process trace test (tests/test_graftsync.py) which
+asserts rank traces are identical and every callsite is one the
+static analyzer predicted (graftsync.collective_sites).
 """
 
 from __future__ import annotations
@@ -19,7 +28,11 @@ from __future__ import annotations
 __jax_free__ = True
 
 import socket
-from typing import List, Optional, Tuple
+import sys
+from collections import deque
+from contextlib import contextmanager
+from typing import (Deque, Iterator, List, NamedTuple, Optional,
+                    Tuple)
 
 import numpy as np
 
@@ -38,6 +51,66 @@ _COLLECTIVE_TIMEOUT = [0.0]
 
 def set_network_timeout(seconds: float) -> None:
     _COLLECTIVE_TIMEOUT[0] = max(0.0, float(seconds))
+
+
+# ---------------------------------------------------------------------------
+# Runtime collective tracer (off by default; ~one list lookup when off)
+# ---------------------------------------------------------------------------
+
+class CollectiveEvent(NamedTuple):
+    """One host collective as this rank executed it."""
+    name: str              # dist.py wrapper the caller used (vote_any, ...)
+    shape: Tuple[int, ...]
+    dtype: str
+    callsite: str          # "file.py:line" of the first frame outside dist
+
+
+#: the active ring buffer, or None when tracing is off
+_TRACE: List[Optional[Deque[CollectiveEvent]]] = [None]
+
+
+def _record_collective(array: np.ndarray) -> None:
+    """Append one event to the active trace.  Every wrapper funnels
+    through process_allgather, so recording there sees them all; the
+    logical name is the OUTERMOST dist.py frame (the wrapper the
+    caller invoked — process_concat's two allgathers both trace as
+    process_concat), the callsite the first frame outside it."""
+    buf = _TRACE[0]
+    if buf is None:
+        return
+    arr = np.asarray(array)
+    frame = sys._getframe(1)
+    name = "process_allgather"
+    while frame is not None and frame.f_code.co_filename == __file__:
+        # skip lambdas (make_metric_reducer's sum-reduce closure lives
+        # in this file): the logical name is the outermost NAMED
+        # wrapper, so a metric-eval allgather traces as
+        # process_allgather, not "<lambda>"
+        if not frame.f_code.co_name.startswith("<"):
+            name = frame.f_code.co_name
+        frame = frame.f_back
+    callsite = "<unknown>"
+    if frame is not None:
+        callsite = "%s:%d" % (frame.f_code.co_filename, frame.f_lineno)
+    buf.append(CollectiveEvent(name, tuple(arr.shape), str(arr.dtype),
+                               callsite))
+
+
+@contextmanager
+def trace_collectives(capacity: int = 1024
+                      ) -> Iterator["Deque[CollectiveEvent]"]:
+    """Enable the per-rank collective ring buffer for a with-block and
+    yield it (a deque capped at `capacity`: steady-state training can
+    run under the tracer without unbounded growth).  Exposed to tests
+    as the `collective_trace` fixture (analysis/guards.py), the same
+    pattern as xla_guard."""
+    prev = _TRACE[0]
+    buf: Deque[CollectiveEvent] = deque(maxlen=max(1, int(capacity)))
+    _TRACE[0] = buf
+    try:
+        yield buf
+    finally:
+        _TRACE[0] = prev
 
 
 def parse_machine_list(path: str) -> List[Tuple[str, int]]:
@@ -146,10 +219,17 @@ def process_allgather(array: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     faultpoint("dist.send")
+    _record_collective(array)
     out = call_with_deadline(
         lambda: np.asarray(multihost_utils.process_allgather(array)),
         _COLLECTIVE_TIMEOUT[0], "process_allgather")
     faultpoint("dist.recv")
+    if out.ndim == np.ndim(array):
+        # a 1-process runtime returns the input unchanged; normalize to
+        # the documented stacked [num_processes, ...] shape so callers
+        # (and single-process tests of the mh agreement paths) see one
+        # contract at any process count
+        out = out[None]
     return out
 
 

@@ -155,13 +155,20 @@ def _sniff_format(path: str, has_header: bool) -> Tuple[str, str]:
 
 
 @contract.jax_free
+@contract.rank_uniform
 def try_fast_predict(cfg: Config) -> bool:
     """Run task=predict through the native path; False -> caller falls
     back to the default JAX path (native toolchain unavailable).
 
     @contract.jax_free: the whole point of this path is the reference
     binary's process-startup profile — graftcheck GC002 verifies
-    nothing it transitively calls imports jax, even lazily."""
+    nothing it transitively calls imports jax, even lazily.
+    @contract.rank_uniform: the decision derives from config (task,
+    modes, native-engine availability) and the shared input model
+    artifact — identical on every rank of a fleet, so graftsync's
+    GC009 accepts the CLI's fast-path early exit ahead of the
+    jax-path fallback (whose booster init allgathers under
+    multi-host)."""
     from . import native
     if native.get_lib() is None:
         return False

@@ -37,6 +37,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..utils import log
 from . import atomic
 from .faults import faultpoint
@@ -181,6 +182,7 @@ class SnapshotManager:
                                max_iteration=max_iteration)
 
     # -- write cadence --------------------------------------------------
+    @contract.rank_uniform
     def due(self, iteration: int) -> bool:
         """True when the segment loop crossed a period boundary since
         the last snapshot (segments may advance several iterations at
@@ -278,6 +280,7 @@ class SnapshotManager:
         return out
 
     # -- resume ---------------------------------------------------------
+    @contract.rank_uniform
     def maybe_resume(self, booster: Any) -> int:
         """Restore the booster per the `resume` policy; returns the
         resumed iteration (0 = fresh start).  Multi-host: all ranks
@@ -343,6 +346,7 @@ class SnapshotManager:
                       "iteration or the SPMD streams diverge"
                       % alls.tolist())
 
+    @contract.rank_uniform
     def _agree_latest(self, iters: List[int]) -> int:
         """resume=auto agreement: the newest iteration EVERY rank holds
         a valid snapshot for.  -1 entries pad the gathered window."""
@@ -364,6 +368,7 @@ class SnapshotManager:
                   "restart with resume=off"
                   % [sorted(s) for s in sets])
 
+    @contract.rank_uniform
     def sync_flag(self, flag: bool) -> bool:
         """OR a per-rank boolean across ranks (preemption agreement:
         one rank's SIGTERM must stop every rank at the same segment

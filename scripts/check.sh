@@ -7,6 +7,11 @@
 #   2. repo-is-clean pytest gates:
 #        tests/test_graftlint.py             rule power + repo clean sweep
 #        tests/test_graftcheck.py            call graph + contract rules
+#        tests/test_graftsync.py             SPMD collective-sequence +
+#                                            lock-order rules (GC009-12),
+#                                            runtime collective tracer,
+#                                            2-process static-vs-runtime
+#                                            cross-check (slow-marked leg)
 #        tests/test_graftcheck_mutations.py  seeded-violation harness:
 #                                            every contract class catches
 #                                            its bug class, clean tree
@@ -48,7 +53,8 @@ fi
 echo "== repo-is-clean pytest gates (graftlint + graftcheck + mutations) =="
 if command -v python >/dev/null 2>&1 && python -c "import pytest" 2>/dev/null; then
     python -m pytest tests/test_graftlint.py tests/test_graftcheck.py \
-        tests/test_graftcheck_mutations.py -q -p no:cacheprovider
+        tests/test_graftsync.py tests/test_graftcheck_mutations.py \
+        -q -p no:cacheprovider
     p=$?
     if [ "$p" -ge 2 ]; then
         echo "check.sh: pytest crashed (exit $p)" >&2

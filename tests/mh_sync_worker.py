@@ -1,0 +1,79 @@
+"""Worker for the 2-process collective-trace test
+(test_graftsync.py::test_two_process_traces_identical_and_statically_predicted).
+
+Usage: python mh_sync_worker.py <rank> <nproc> <port> <data> <trace_out>
+       <snap_dir>
+
+Each worker joins the jax distributed runtime, then runs the REAL
+multi-host paths under dist.trace_collectives(): dataset load (cache
+vote + distributed bin finding), booster init (pad-length agreement),
+snapshot resume agreement, a short tree_learner=data training with the
+early-stop sync hook wired exactly as cli.init_train wires it, and a
+preemption sync_flag.  The trace dumps to JSON for the parent to
+compare across ranks and against graftsync's static model.
+"""
+
+import json
+import os
+import sys
+
+rank, nproc, port, data, trace_out, snap_dir = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(coordinator_address="localhost:" + port,
+                           num_processes=nproc, process_id=rank)
+assert jax.device_count() == 4 * nproc, jax.devices()
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.parallel.dist import (trace_collectives,  # noqa: E402
+                                        vote_any)
+from lightgbm_tpu.resilience.snapshot import SnapshotManager  # noqa: E402
+
+cfg = Config.from_params({
+    "objective": "binary", "tree_learner": "data", "num_leaves": "8",
+    "min_data_in_leaf": "5", "min_sum_hessian_in_leaf": "1",
+    "hist_dtype": "float64", "metric": "", "is_save_binary_file": "false"})
+
+with trace_collectives() as events:
+    from lightgbm_tpu.io.dataset import load_dataset
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    ds = load_dataset(data, cfg, rank=rank, num_shards=nproc)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = create_boosting(cfg, ds, obj)
+    # the early-stop agreement hook, wired exactly as cli.init_train
+    # wires it for num_machines > 1 — collectives it fires dispatch
+    # DYNAMICALLY (the static model can't bind them; the parent test
+    # accepts them via the registered stop_sync hook)
+    booster.stop_sync = vote_any
+    # resume agreement over an empty snapshot dir: every rank gathers
+    # its (empty) valid-iteration window and agrees on a fresh start
+    snaps = SnapshotManager(snap_dir, period=1, resume="auto",
+                            rank=rank, num_machines=nproc)
+    assert snaps.maybe_resume(booster) == 0
+    for _ in range(3):
+        booster.train_one_iter(None, None, False)
+    # one preemption sync, as cli.train runs per segment
+    assert snaps.sync_flag(False) is False
+
+doc = [dict(name=e.name, shape=list(e.shape), dtype=e.dtype,
+            callsite=e.callsite) for e in events]
+with open(trace_out, "w") as f:
+    json.dump(doc, f, indent=1)
+print("worker %d traced %d collective(s)" % (rank, len(doc)))

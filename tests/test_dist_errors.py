@@ -1,0 +1,155 @@
+"""parallel/dist.py error paths and wrapper semantics.
+
+The 2-process tests prove the happy path; these pin the FAILURE
+contract single-process: a dead peer surfaces as a typed NetworkError
+out of process_allgather (instead of hanging the trainer), vote_any's
+truth table, and process_concat's ragged/0-row assembly — the shapes
+the reference's Bruck allgather handled that the padded-gather wrapper
+must reproduce.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.parallel import dist
+
+
+@pytest.fixture
+def _restore_timeout():
+    yield
+    dist.set_network_timeout(0.0)
+
+
+class TestDeadline:
+    def test_timeout_surfaces_network_error(self, monkeypatch,
+                                            _restore_timeout):
+        """A peer that never answers: the configured deadline turns the
+        blocked collective into NetworkError naming the operation."""
+        import jax.experimental.multihost_utils as mh
+
+        hang = threading.Event()
+
+        def never_returns(array):
+            hang.wait(30.0)
+            return array
+
+        monkeypatch.setattr(mh, "process_allgather", never_returns)
+        dist.set_network_timeout(0.2)
+        with pytest.raises(dist.NetworkError,
+                           match="process_allgather"):
+            dist.process_allgather(np.zeros(3))
+
+    def test_zero_timeout_means_wait(self, monkeypatch):
+        """timeout 0 = wait forever (the default): the call runs
+        inline and returns."""
+        dist.set_network_timeout(0.0)
+        out = dist.process_allgather(np.arange(4))
+        assert out.shape == (1, 4)
+
+    def test_peer_exception_propagates_typed(self, monkeypatch,
+                                             _restore_timeout):
+        """An error INSIDE the collective (not a timeout) propagates
+        as itself — the deadline wrapper must not swallow or retype
+        transport-layer diagnostics."""
+        import jax.experimental.multihost_utils as mh
+
+        def boom(array):
+            raise RuntimeError("transport exploded")
+
+        monkeypatch.setattr(mh, "process_allgather", boom)
+        dist.set_network_timeout(5.0)
+        with pytest.raises(RuntimeError, match="transport exploded"):
+            dist.process_allgather(np.zeros(1))
+
+
+class TestVoteAny:
+    def test_truth_table_single_process(self):
+        assert dist.vote_any(True) is True
+        assert dist.vote_any(False) is False
+
+    @pytest.mark.parametrize("votes,expect", [
+        ([0, 0, 0], False),
+        ([0, 1, 0], True),
+        ([1, 1, 1], True),
+        ([1], True),
+        ([0], False),
+    ])
+    def test_truth_table_simulated_ranks(self, monkeypatch, votes,
+                                         expect):
+        """vote_any over P simulated ranks: any rank's True wins."""
+        def fake_allgather(array):
+            return np.stack([np.full_like(np.asarray(array), v)
+                             for v in votes])
+
+        monkeypatch.setattr(dist, "process_allgather", fake_allgather)
+        assert dist.vote_any(bool(votes[0])) is expect
+
+
+class TestProcessConcat:
+    def _patch_ranks(self, monkeypatch, per_rank):
+        """Simulate P ranks: each call to process_allgather answers
+        with the stacked per-rank values for THIS rank's payload
+        position (lengths first, padded data second)."""
+        calls = {"n": 0}
+
+        def fake_allgather(array):
+            arr = np.asarray(array)
+            if calls["n"] == 0:
+                calls["n"] += 1
+                return np.stack([
+                    np.array([r.shape[0]], dtype=np.int64)
+                    for r in per_rank])
+            mx = max(r.shape[0] for r in per_rank)
+            out = []
+            for r in per_rank:
+                pad = np.zeros((mx,) + r.shape[1:], dtype=r.dtype)
+                pad[:r.shape[0]] = r
+                out.append(pad)
+            return np.stack(out)
+
+        monkeypatch.setattr(dist, "process_allgather", fake_allgather)
+
+    def test_unequal_per_rank_shapes(self, monkeypatch):
+        a = np.arange(6.0).reshape(3, 2)
+        b = np.arange(2.0).reshape(1, 2) + 100
+        self._patch_ranks(monkeypatch, [a, b])
+        out = dist.process_concat(a)
+        np.testing.assert_array_equal(out, np.concatenate([a, b]))
+
+    def test_zero_row_rank(self, monkeypatch):
+        """A rank with NO rows (an empty lottery shard) contributes
+        nothing — and its padding never leaks into the result."""
+        a = np.arange(4.0).reshape(2, 2)
+        b = np.zeros((0, 2))
+        self._patch_ranks(monkeypatch, [a, b])
+        out = dist.process_concat(a)
+        np.testing.assert_array_equal(out, a)
+
+    def test_all_ranks_empty(self, monkeypatch):
+        a = np.zeros((0, 3))
+        self._patch_ranks(monkeypatch, [a, a])
+        out = dist.process_concat(a)
+        assert out.shape == (0, 3)
+
+    def test_single_process_identity(self):
+        a = np.arange(6.0).reshape(3, 2)
+        np.testing.assert_array_equal(dist.process_concat(a), a)
+
+
+class TestSyncMaxInts:
+    def test_elementwise_max_simulated(self, monkeypatch):
+        rows = [np.array([3, 1, 7], dtype=np.int64),
+                np.array([2, 9, 4], dtype=np.int64)]
+
+        def fake_allgather(array):
+            return np.stack(rows)
+
+        monkeypatch.setattr(dist, "process_allgather", fake_allgather)
+        np.testing.assert_array_equal(dist.sync_max_ints([3, 1, 7]),
+                                      [3, 9, 7])
+
+    def test_single_process_identity(self):
+        np.testing.assert_array_equal(dist.sync_max_ints([5, 2]),
+                                      [5, 2])

@@ -59,7 +59,8 @@ def test_every_contract_class_has_two_mutations():
     classes = contract_classes()
     assert set(classes) == {"traced_pure", "jax_free", "parity_oracle",
                             "locked_by", "fused_body", "counted_flush",
-                            "durable_write"}
+                            "durable_write", "spmd_collectives",
+                            "lock_order"}
     for cls in classes:
         n = sum(1 for m in MUTATIONS if m.contract == cls)
         assert n >= 2, "contract class %r has %d mutation(s), want >= 2" \

@@ -1,0 +1,806 @@
+"""graftsync + lockgraph rule tests (GC009-GC012) and the runtime
+collective tracer, including the 2-process static-vs-runtime
+cross-check (slow).
+
+The synthetic package images go through run_graftcheck_sources — the
+same entry the seeded-violation harness uses — with a stub
+parallel/dist.py so collective calls resolve to the sanctioned entry
+module exactly like the real tree's do.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.analysis.callgraph import CallGraph
+from lightgbm_tpu.analysis.graftcheck import run_graftcheck_sources
+from lightgbm_tpu.analysis.graftsync import collective_sites
+from lightgbm_tpu.analysis.contracts import HOST_COLLECTIVES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+
+#: stub sanctioned entry module — calls into it are atoms, like the
+#: real parallel/dist.py's wrappers
+DIST_STUB = """
+    def process_allgather(a):
+        return a
+
+    def vote_any(flag):
+        return bool(flag)
+
+    def sync_max_ints(v):
+        return v
+"""
+
+
+def synth(**modules):
+    out = {"__init__.py": "", "parallel/__init__.py": "",
+           "parallel/dist.py": textwrap.dedent(DIST_STUB)}
+    for name, src in modules.items():
+        out[name.replace("__", "/") + ".py"] = textwrap.dedent(src)
+    return out
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# GC009 — collective-sequence divergence
+# ---------------------------------------------------------------------------
+
+class TestSequenceDivergence:
+    def test_rank_gated_collective_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import vote_any
+
+            def step(rank, flag):
+                if rank == 0:
+                    return vote_any(flag)
+                return flag
+        """))
+        hits = by_rule(fs, "GC009")
+        assert len(hits) == 1 and hits[0].path == "a.py"
+        assert "vote_any" in hits[0].message
+
+    def test_same_set_different_order_flagged(self):
+        """The sequence-sensitive core: both arms run the SAME
+        collective set, in a different order — a set-uniformity check
+        (GC005's model) would pass this; ranks still deadlock."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather, vote_any
+
+            def step(rank, x):
+                if rank % 2 == 0:
+                    vote_any(False)
+                    y = process_allgather(x)
+                else:
+                    y = process_allgather(x)
+                    vote_any(False)
+                return y
+        """))
+        hits = by_rule(fs, "GC009")
+        assert hits and "different collective sequences" in \
+            hits[0].message
+
+    def test_vote_derived_condition_accepted(self):
+        """The vote-then-branch idiom: the branch condition came off a
+        collective, so every rank agrees — no finding."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather, vote_any
+
+            def step(rank, flag, x):
+                agreed = vote_any(flag)
+                if agreed:
+                    return process_allgather(x)
+                return x
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_config_condition_accepted(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            def step(config, x):
+                if config.num_machines > 1:
+                    x = process_allgather(x)
+                return x
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_rank_uniform_annotation_accepted(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            @contract.rank_uniform
+            def decide(x):
+                return x > 0
+
+            def step(x, data):
+                if decide(x):
+                    return process_allgather(data)
+                return process_allgather(data)
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_unannotated_helper_condition_flagged(self):
+        """Same shape as above WITHOUT the annotation: the helper's
+        result is rank-local until someone claims otherwise."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            def decide(x):
+                return x > 0
+
+            def step(x, data):
+                if decide(x):
+                    return process_allgather(data)
+                return data
+        """))
+        assert by_rule(fs, "GC009")
+
+    def test_abort_arm_exempt(self):
+        """log.fatal / raise arms are exempt: the dead rank surfaces
+        as NetworkError on its peers via the collective deadline."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+            from .utils_log import log
+
+            def step(ok, x):
+                if not ok:
+                    log.fatal("bad rank-local state")
+                return process_allgather(x)
+        """, utils_log="""
+            class log:
+                @staticmethod
+                def fatal(msg):
+                    raise SystemExit(msg)
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_early_exit_before_collective_flagged(self):
+        """A local filesystem probe gates an early return ahead of a
+        collective — the io/dataset.py cache-divergence shape this PR
+        closed with the vote_any agreement."""
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(path, x):
+                if os.path.isfile(path):
+                    return x
+                return process_allgather(x)
+        """))
+        hits = by_rule(fs, "GC009")
+        assert hits and "early exit" in hits[0].message
+
+    def test_early_exit_with_no_later_collective_clean(self):
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(path, x):
+                y = process_allgather(x)
+                if os.path.isfile(path):
+                    return y
+                return y + 1
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_early_return_inside_loop_before_collective_flagged(self):
+        """A rank-local return INSIDE a loop, collective after the
+        loop: the pending exit must survive the loop boundary (review
+        regression — it used to be dropped there)."""
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(shards, x):
+                for s in shards:
+                    if os.path.exists(s):
+                        return None
+                return process_allgather(x)
+        """))
+        hits = by_rule(fs, "GC009")
+        assert hits and "early exit" in hits[0].message
+
+    def test_break_does_not_leak_past_loop(self):
+        """A rank-local BREAK only skips the loop (and its else) — a
+        collective after the loop still runs on every rank, so no
+        finding."""
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(shards, x):
+                for s in shards:
+                    if os.path.exists(s):
+                        break
+                return process_allgather(x)
+        """))
+        assert by_rule(fs, "GC009") == []
+        assert by_rule(fs, "GC010") == []
+
+    def test_break_skipping_loop_else_collective_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(shards, x):
+                for s in shards:
+                    if os.path.exists(s):
+                        break
+                else:
+                    x = process_allgather(x)
+                return x
+        """))
+        assert by_rule(fs, "GC009")
+
+    def test_early_return_in_try_before_collective_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(path, x):
+                try:
+                    if os.path.exists(path):
+                        return x
+                except OSError:
+                    pass
+                return process_allgather(x)
+        """))
+        hits = by_rule(fs, "GC009")
+        assert hits and "early exit" in hits[0].message
+
+    def test_early_return_with_collective_in_finally_clean(self):
+        """`finally` runs on the early-exiting rank too — a collective
+        there is NOT skipped, so no finding."""
+        fs = run_graftcheck_sources(synth(a="""
+            import os
+
+            from .parallel.dist import process_allgather
+
+            def step(path, x):
+                try:
+                    if os.path.exists(path):
+                        return x
+                finally:
+                    process_allgather(x)
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_collective_in_except_handler_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import vote_any
+
+            def step(x):
+                try:
+                    return x.decode()
+                except Exception:
+                    vote_any(True)
+                    return None
+        """))
+        hits = by_rule(fs, "GC009")
+        assert hits and "exception handler" in hits[0].message
+
+    def test_assignment_under_rank_local_branch_poisons_name(self):
+        """`if rank == 0: flag = True` must not launder `flag` to
+        uniform — whether the assignment RAN is rank-local (review
+        regression)."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            def step(rank, x):
+                flag = rank == 0
+                if rank == 0:
+                    flag = True
+                if flag:
+                    return process_allgather(x)
+                return x
+        """))
+        assert by_rule(fs, "GC009")
+
+    def test_uniform_branch_reassignment_keeps_vote_idiom(self):
+        """The vote-then-branch idiom under a UNIFORM guard keeps the
+        last-assignment-wins rule (cli.train's preemption sync)."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather, vote_any
+
+            def step(config, local_flag, x):
+                stop = local_flag()
+                if config.num_machines > 1:
+                    stop = vote_any(stop)
+                if stop:
+                    return x
+                return process_allgather(x)
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_while_head_relaundered_by_body_flagged(self):
+        """A while body that leaves its own condition rank-local (the
+        re-sync dropped) diverges from iteration 2 on — the head is
+        re-evaluated against the post-body env (review regression)."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import vote_any
+
+            def step(local_done):
+                stop = False
+                while not stop:
+                    vote_any(False)
+                    stop = local_done()
+        """))
+        assert by_rule(fs, "GC010")
+
+    def test_nested_collective_call_orders_as_evaluated(self):
+        """Atoms order by EVALUATION (arguments before the outer
+        call): nesting a collective inside another's arguments is
+        sequence-equal to the flat form (review regression — a
+        lineno/col sort inverted them)."""
+        fs = run_graftcheck_sources(synth(a="""
+            import numpy as np
+
+            from .parallel.dist import process_allgather, vote_any
+
+            def step(rank, flag, x):
+                if rank == 0:
+                    y = process_allgather(np.array([vote_any(flag)]))
+                else:
+                    v = vote_any(flag)
+                    y = process_allgather(np.array([v]))
+                return y
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_divergence_two_calls_deep(self):
+        """The collective hides two resolvable calls below the
+        rank-gated branch — interprocedural, like GC001's bar."""
+        fs = run_graftcheck_sources(synth(
+            a="""
+                from .b import outer
+
+                def step(rank, x):
+                    if rank == 0:
+                        outer(x)
+                    return x
+            """,
+            b="""
+                from .c import inner
+
+                def outer(x):
+                    return inner(x)
+            """,
+            c="""
+                from .parallel.dist import process_allgather
+
+                def inner(x):
+                    return process_allgather(x)
+            """))
+        hits = by_rule(fs, "GC009")
+        assert hits and hits[0].path == "a.py"
+        assert "process_allgather" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# GC010 — collectives in rank-local loops
+# ---------------------------------------------------------------------------
+
+class TestRankLocalLoops:
+    def test_rank_bound_loop_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            def step(rank, x):
+                for _ in range(rank):
+                    x = process_allgather(x)
+                return x
+        """))
+        hits = by_rule(fs, "GC010")
+        assert len(hits) == 1 and "range(rank)" in hits[0].message
+
+    def test_config_bound_loop_accepted(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            def step(config, x):
+                for _ in range(config.num_iterations):
+                    x = process_allgather(x)
+                return x
+        """))
+        assert by_rule(fs, "GC010") == []
+
+    def test_local_break_in_collective_loop_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import vote_any
+
+            def step(config, rank):
+                for i in range(config.num_iterations):
+                    if i >= rank:
+                        break
+                    vote_any(False)
+        """))
+        hits = by_rule(fs, "GC010")
+        assert hits and "early exit" in hits[0].message
+
+    def test_synced_stop_loop_accepted(self):
+        """cli.train's shape: the loop's stop flag is refreshed from a
+        collective each iteration (line-order dataflow accepts the
+        reassignment)."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import vote_any
+
+            def step(config, local_done):
+                stop = False
+                while not stop:
+                    stop = local_done()
+                    stop = vote_any(stop)
+        """))
+        assert by_rule(fs, "GC010") == []
+        assert by_rule(fs, "GC009") == []
+
+
+# ---------------------------------------------------------------------------
+# GC011 — single collective entry point
+# ---------------------------------------------------------------------------
+
+class TestCollectiveEntry:
+    def test_multihost_import_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from jax.experimental import multihost_utils
+
+            def sync(x):
+                return multihost_utils.process_allgather(x)
+        """))
+        hits = by_rule(fs, "GC011")
+        assert hits and hits[0].path == "a.py"
+        assert "multihost_utils" in hits[0].message
+
+    def test_jax_distributed_attribute_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            import jax
+
+            def boot(addr):
+                jax.distributed.initialize(coordinator_address=addr)
+        """))
+        hits = by_rule(fs, "GC011")
+        assert hits and "jax.distributed.initialize" in hits[0].message
+
+    def test_dist_module_is_sanctioned(self):
+        # parallel/dist.py itself may (must) use multihost directly
+        srcs = synth()
+        srcs["parallel/dist.py"] += textwrap.dedent("""
+            def real_gather(x):
+                from jax.experimental import multihost_utils
+                return multihost_utils.process_allgather(x)
+        """)
+        fs = run_graftcheck_sources(srcs)
+        assert by_rule(fs, "GC011") == []
+
+
+# ---------------------------------------------------------------------------
+# GC012 — lock order
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_inverted_nesting_cycle_flagged(self):
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._load_lock = threading.Lock()
+
+                def a(self):
+                    with self._load_lock:
+                        with self._lock:
+                            pass
+
+                def b(self):
+                    with self._lock:
+                        with self._load_lock:
+                            pass
+        """))
+        hits = by_rule(fs, "GC012")
+        assert hits and "cycle" in hits[0].message
+        assert "Pool._lock" in hits[0].message
+
+    def test_consistent_order_clean(self):
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._load_lock = threading.Lock()
+
+                def a(self):
+                    with self._load_lock:
+                        with self._lock:
+                            pass
+
+                def b(self):
+                    with self._load_lock:
+                        with self._lock:
+                            pass
+        """))
+        assert by_rule(fs, "GC012") == []
+
+    def test_blocking_under_fast_lock_flagged(self):
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def a(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """))
+        hits = by_rule(fs, "GC012")
+        assert hits and "time.sleep" in hits[0].message
+
+    def test_blocking_reached_through_callee_flagged(self):
+        """The load two calls away still counts: fleet.py's
+        loads-outside-pool-lock discipline, interprocedurally."""
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _slow(self):
+                    conn = object()
+                    conn.recv(1024)
+
+                def a(self):
+                    with self._lock:
+                        self._slow()
+        """))
+        hits = by_rule(fs, "GC012")
+        assert hits and "_slow" in hits[0].message
+
+    def test_allowed_lock_may_block(self):
+        """A lock registered in contracts.LOCK_ALLOWED_BLOCKING (the
+        fleet's _load_lock) may sit across a blocking op."""
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+            import time
+
+            class ModelFleet:
+                def __init__(self):
+                    self._load_lock = threading.Lock()
+
+                def a(self):
+                    with self._load_lock:
+                        time.sleep(1.0)
+        """))
+        assert by_rule(fs, "GC012") == []
+
+    def test_event_wait_under_lock_flagged(self):
+        """`Event.wait()` blocks WITH the lock held (unlike cv.wait,
+        which releases it) — flagged directly, consistent with the
+        same wait one helper call deeper (review regression)."""
+        fs = run_graftcheck_sources(synth(serving__pool="""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+
+                def a(self):
+                    with self._lock:
+                        self._stop.wait(1.0)
+        """))
+        hits = by_rule(fs, "GC012")
+        assert hits and "wait" in hits[0].message
+
+    def test_cv_wait_under_its_own_lock_exempt(self):
+        fs = run_graftcheck_sources(synth(serving__batch="""
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def take(self):
+                    with self._cv:
+                        self._cv.wait(0.5)
+        """))
+        assert by_rule(fs, "GC012") == []
+
+
+# ---------------------------------------------------------------------------
+# Repo gates + static-model surface
+# ---------------------------------------------------------------------------
+
+class TestRepoGates:
+    def test_static_sites_cover_known_collectives(self):
+        """The static model resolves the tree's real collective call
+        sites — the same set the 2-process trace test checks runtime
+        callsites against."""
+        sites = collective_sites(CallGraph.from_root(PKG))
+        mods = {(rel, name) for rel, _line, name in sites}
+        assert ("io/binning.py", "process_allgather") in mods
+        assert ("models/gbdt.py", "process_allgather") in mods
+        assert ("resilience/snapshot.py", "vote_any") in mods
+        assert ("resilience/snapshot.py", "process_allgather") in mods
+        assert ("io/dataset.py", "vote_any") in mods
+        for _rel, _line, name in sites:
+            assert name in HOST_COLLECTIVES
+
+    def test_list_rules_names_sync_rules(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu.analysis",
+             "--list-rules"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0
+        for rid in ("GC009", "GC010", "GC011", "GC012"):
+            assert rid in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Runtime tracer (single process)
+# ---------------------------------------------------------------------------
+
+class TestRuntimeTracer:
+    def test_trace_captures_wrapper_name_and_callsite(
+            self, collective_trace):
+        from lightgbm_tpu.parallel import dist
+        with collective_trace() as events:
+            dist.vote_any(False)
+        assert len(events) == 1
+        ev = events[0]
+        assert ev.name == "vote_any"
+        assert ev.shape == (1,) and ev.dtype == "int64"
+        assert os.path.basename(__file__) in ev.callsite
+
+    def test_process_concat_traces_each_gather(self, collective_trace):
+        from lightgbm_tpu.parallel import dist
+        with collective_trace() as events:
+            out = dist.process_concat(np.arange(6.0).reshape(3, 2))
+        assert out.shape == (3, 2)
+        names = [e.name for e in events]
+        assert names == ["process_concat", "process_concat"]
+
+    def test_metric_reducer_traces_as_allgather(self,
+                                                collective_trace):
+        """make_metric_reducer's closures live in dist.py as lambdas:
+        the logical event name must still be the named wrapper
+        (process_allgather), never '<lambda>' — the 2-process
+        cross-check requires every name in HOST_COLLECTIVES."""
+        from lightgbm_tpu.parallel import dist
+        reduce_sum, _concat = dist.make_metric_reducer()
+        with collective_trace() as events:
+            out = reduce_sum([1.5, 2.5])
+        np.testing.assert_allclose(out, [1.5, 2.5])
+        assert [e.name for e in events] == ["process_allgather"]
+        assert os.path.basename(__file__) in events[0].callsite
+
+    def test_trace_off_by_default_and_capped(self, collective_trace):
+        from lightgbm_tpu.parallel import dist
+        dist.vote_any(False)          # no active trace: no effect
+        with collective_trace(capacity=3) as events:
+            for _ in range(5):
+                dist.vote_any(False)
+        assert len(events) == 3       # ring buffer keeps the newest
+
+    def test_runtime_callsites_are_statically_known(
+            self, collective_trace, tmp_path):
+        """Single-process mini version of the 2-process check: drive a
+        real collective through a package path and assert the traced
+        callsite is one the static model predicted."""
+        from lightgbm_tpu.resilience.snapshot import SnapshotManager
+
+        # num_machines=2 in ONE process still runs the collectives
+        # (a 1-process allgather is the identity) — it exercises the
+        # real package callsites without a second process
+        snaps = SnapshotManager(str(tmp_path), period=1, resume="auto",
+                                num_machines=2)
+        with collective_trace() as events:
+            snaps.sync_flag(False)
+            assert snaps.maybe_resume(object()) == 0
+        in_pkg = [e for e in events if "lightgbm_tpu" in e.callsite]
+        assert {e.name for e in in_pkg} == {"vote_any",
+                                            "process_allgather"}
+        sites = collective_sites(CallGraph.from_root(PKG))
+        for ev in in_pkg:
+            rel, _, line = ev.callsite.rpartition(":")
+            rel = rel.split("lightgbm_tpu" + os.sep, 1)[-1].replace(
+                os.sep, "/")
+            assert (rel, int(line), ev.name) in sites, ev
+
+
+# ---------------------------------------------------------------------------
+# The 2-process runtime-vs-static cross-check
+# ---------------------------------------------------------------------------
+
+#: attribute names through which the tree dispatches a collective
+#: DYNAMICALLY (function-valued hooks the static resolver cannot
+#: bind): GBDT.stop_sync (cli wires it to vote_any) and the metric
+#: reducers (Metric.set_reducer installs dist.make_metric_reducer's
+#: closures).  A traced callsite is accepted when its source line goes
+#: through one of these; anything else must be a statically-resolved
+#: site.  Growing this list is a reviewed decision.
+DYNAMIC_COLLECTIVE_HOOKS = ("stop_sync", "reduce_sum", "self.concat(")
+
+
+@pytest.mark.slow
+def test_two_process_traces_identical_and_statically_predicted(
+        tmp_path):
+    """REAL 2-process run: both ranks trace every host collective of a
+    tree_learner=data training (distributed bin finding, pad-length
+    agreement, cache vote, snapshot resume agreement, preemption
+    sync, early-stop sync).  Asserts (1) the two ranks' traces are
+    IDENTICAL event-for-event — names, shapes, dtypes, callsites —
+    and (2) every callsite inside the package is one graftsync's
+    static model resolves (or a registered dynamic hook)."""
+    import socket as socketlib
+
+    rng = np.random.RandomState(0)
+    n, ncol = 400, 5
+    x = rng.randn(n, ncol)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    data = tmp_path / "train.tsv"
+    data.write_text("\n".join(
+        "\t".join([str(y[i])] + ["%f" % v for v in x[i]])
+        for i in range(n)) + "\n")
+
+    s = socketlib.socket()
+    s.bind(("localhost", 0))
+    port = str(s.getsockname()[1])
+    s.close()
+
+    traces = [str(tmp_path / ("trace_%d.json" % r)) for r in range(2)]
+    snapdir = str(tmp_path / "snaps")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "mh_sync_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), "2", port, str(data),
+         traces[r], snapdir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (r, logs[r])
+
+    t0 = json.load(open(traces[0]))
+    t1 = json.load(open(traces[1]))
+    assert len(t0) >= 5, "trace too thin to be meaningful: %r" % t0
+    assert t0 == t1, (
+        "rank collective traces diverge:\nrank0=%s\nrank1=%s"
+        % (json.dumps(t0, indent=1), json.dumps(t1, indent=1)))
+
+    sites = collective_sites(CallGraph.from_root(PKG))
+    for ev in t0:
+        name, callsite = ev["name"], ev["callsite"]
+        assert name in HOST_COLLECTIVES, ev
+        assert "lightgbm_tpu" in callsite, (
+            "collective called from outside the package: %r" % ev)
+        path, _, line = callsite.rpartition(":")
+        rel = path.split("lightgbm_tpu" + os.sep, 1)[-1].replace(
+            os.sep, "/")
+        if (rel, int(line), name) in sites:
+            continue
+        src_line = open(path).read().splitlines()[int(line) - 1]
+        assert any(h in src_line for h in DYNAMIC_COLLECTIVE_HOOKS), (
+            "runtime collective at %s not in the static model and not "
+            "a registered dynamic hook (line: %s)" % (callsite,
+                                                      src_line))

@@ -34,6 +34,9 @@ from lightgbm_tpu.ops.split import (SplitParams, find_best_split,
                                     find_best_split_fused)
 from lightgbm_tpu.utils.log import LightGBMError
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 # ---------------------------------------------------------------------------
 # kernel-level parity vs the two-op oracle

@@ -29,6 +29,9 @@ from lightgbm_tpu.serving.server import ServingServer
 from conftest import GOLDEN_DIR, REFERENCE_DIR
 from test_predict_fast import BINARY_MODEL, MULTI_MODEL, _rows
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 EXAMPLES = os.path.join(REFERENCE_DIR, "examples")
 
 MODE_ARGS = {"normal": (), "raw": ("is_predict_raw_score=true",),

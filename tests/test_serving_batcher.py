@@ -16,6 +16,9 @@ import pytest
 from lightgbm_tpu.serving.batcher import (MicroBatcher, RowsPayload,
                                           TextPayload, count_rows)
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 def _echo_runner(record=None):
     """run_batch that 'predicts' each row as itself (identity), so any

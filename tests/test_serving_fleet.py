@@ -23,6 +23,9 @@ from lightgbm_tpu.serving.server import ServingServer, ServingState
 
 from test_predict_fast import BINARY_MODEL
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 MODEL_B = BINARY_MODEL.replace("leaf_value=0.2 -0.13 0.34",
                                "leaf_value=0.9 -0.7 0.55")
 MODEL_C = BINARY_MODEL.replace("leaf_value=0.2 -0.13 0.34",

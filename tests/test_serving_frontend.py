@@ -22,6 +22,9 @@ from lightgbm_tpu.serving.frontend import Frontend
 from test_predict_fast import BINARY_MODEL
 from test_serving import cli_predict
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 BODY = b"0\t1.5\t-0.25\t0.75\t2.0\n0\t-1\t0\t0.3\t0.1\n"
 
 

@@ -26,6 +26,9 @@ from lightgbm_tpu.serving.server import ServingServer, ServingState
 
 from test_predict_fast import BINARY_MODEL, MULTI_MODEL, _rows
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 MODES = ("normal", "raw", "leaf")
 
 

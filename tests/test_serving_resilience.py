@@ -20,6 +20,9 @@ from lightgbm_tpu.resilience import faults
 from test_predict_fast import BINARY_MODEL, _rows
 from test_serving import _tsv_body, _write, cli_predict, get, post, serve
 
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 @pytest.fixture(autouse=True)
 def _clean_fault_registry():
