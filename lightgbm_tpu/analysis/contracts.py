@@ -115,7 +115,7 @@ JAX_FREE_MARKER = "__jax_free__"
 #: contract one way or the other.
 DECLARE_DIRS: Tuple[str, ...] = ("serving", "io", "utils", "analysis",
                                  "native", "parallel", "models",
-                                 "resilience", "ingest")
+                                 "resilience", "ingest", "refresh")
 
 #: modules PINNED jax-free: these must declare `__jax_free__ = True` —
 #: deleting the marker (or flipping it to False) is a finding (GC007),
@@ -137,12 +137,16 @@ EXPECTED_JAX_FREE: Tuple[str, ...] = (
     # the fault-tolerance layer rides inside the jax-free fast paths
     # (predict_fast results, serving fallback, CLI snapshot cadence)
     "resilience/__init__.py", "resilience/atomic.py",
-    "resilience/faults.py", "resilience/net.py",
-    "resilience/snapshot.py",
+    "resilience/backoff.py", "resilience/faults.py",
+    "resilience/net.py", "resilience/snapshot.py",
     # out-of-core ingestion: the parse/shard-write paths run in
     # jax-free lanes (CLI task=ingest, multiprocessing parse workers)
     "ingest/__init__.py", "ingest/manifest.py", "ingest/writer.py",
     "ingest/shards.py", "ingest/synth.py",
+    # continuous refresh: the deploy agent is a supervisor-family
+    # process (watch + subprocess + HTTP) — a jax import here would
+    # tax every cycle with a backend init the agent never uses
+    "refresh/__init__.py", "refresh/agent.py",
 )
 
 # ---------------------------------------------------------------------------

@@ -235,6 +235,12 @@ class _SyncAnalyzer:
         if dotted in RANK_UNIFORM_CALLS:
             return True
         name = _terminal(dotted) if dotted else ""
+        if dotted == "isinstance" and len(call.args) == 2:
+            # the TYPE argument is a class expression — program text,
+            # identical on every rank by construction — so only the
+            # tested VALUE decides uniformity (a module-level class
+            # name would otherwise read as attribute soup)
+            return self._uniform(fn, call.args[0], env)
         if dotted is not None and dotted in _UNIFORM_BUILTINS:
             return all(self._uniform(fn, a, env) for a in call.args)
         targets = self._resolve(fn, call.func)

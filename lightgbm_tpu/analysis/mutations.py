@@ -288,6 +288,21 @@ def _t_bare_sidecar_savez(src: str) -> str:
         what="bare np.savez sidecar mirror into io/dataset.py")
 
 
+def _t_marker_off_refresh_agent(src: str) -> str:
+    return _replace_once(
+        src, "\n__jax_free__ = True\n", "\n",
+        what="__jax_free__ marker removal from refresh/agent.py")
+
+
+def _t_bare_state_write_in_agent(src: str) -> str:
+    return _insert_before(
+        src, "        atomic_write_bytes(self._state_path,",
+        "        with open(self._state_path, 'wb') as f:"
+        "  # seeded violation\n"
+        "            f.write(json.dumps(doc).encode())\n",
+        what="bare open('wb') state write into the refresh agent")
+
+
 # ---------------------------------------------------------------------------
 # spmd_collectives — rank-divergent collective sequences (graftsync)
 # ---------------------------------------------------------------------------
@@ -506,6 +521,21 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "a bare np.savez of the rows sidecar outside the atomic helper "
        "— a truncated sidecar desyncs the cluster's row partition",
        _t_bare_sidecar_savez),
+
+    _m("marker-removed-from-refresh-agent", "jax_free",
+       "refresh/agent.py", "GC007", "refresh/agent.py",
+       "pinned jax-free",
+       "deleting the __jax_free__ declaration from the deploy agent — "
+       "bypassing the EXPECTED_JAX_FREE registry would let a jax "
+       "import tax every refresh cycle with a backend init",
+       _t_marker_off_refresh_agent),
+    _m("bare-state-write-in-agent", "durable_write",
+       "refresh/agent.py", "GC008", "refresh/agent.py",
+       "open(.., 'wb')",
+       "a bare open('wb') of the agent's durable state file — a crash "
+       "mid-write truncates the consumed-drops ledger and the rerun "
+       "double-trains or skips data",
+       _t_bare_state_write_in_agent),
 
     _m("rank-gated-vote-any", "spmd_collectives",
        "resilience/snapshot.py", "GC009", "resilience/snapshot.py",

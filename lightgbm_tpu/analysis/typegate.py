@@ -34,7 +34,7 @@ GATED_MODULES = (
     "config.py",
     "api.py",
 )
-GATED_DIRS = ("serving", "analysis")
+GATED_DIRS = ("serving", "analysis", "refresh")
 
 
 def gated_modules(root: Optional[str] = None) -> List[str]:

@@ -290,7 +290,10 @@ class Dataset:
             self.set_group(self._group)
         if self._init_score is not None:
             self.set_init_score(self._init_score)
-        if self.free_raw_data:
+        if self.free_raw_data and not isinstance(self._raw, str):
+            # free_raw_data drops raw MATRICES (the memory the flag is
+            # about); a file path is identity, not data — keeping it
+            # lets init_model continued training re-read the rows
             self._raw = None
 
     # -- fields (LGBM_DatasetSet/GetField, c_api.cpp:357-391) ----------
@@ -608,13 +611,80 @@ class Booster:
         return dict(sorted(counts.items(), key=lambda kv: -kv[1]))
 
 
+def _seed_init_scores(old: Booster, ds: Dataset) -> None:
+    """Install the old model's raw predictions over `ds`'s rows as its
+    init_score — the reference's continued-training pass (re-boost from
+    predicted scores, application.cpp:106-180 / predictor.hpp), shared
+    semantics with cli.Application._set_init_scores.  Needs the
+    dataset's raw features: matrices keep them with
+    free_raw_data=False, file-backed datasets keep the path."""
+    inner = ds.inner
+    raw = ds._raw
+    gb = old._gbdt
+    if raw is None:
+        log.fatal("init_model continued training needs the dataset's "
+                  "raw features to predict init scores — construct the "
+                  "Dataset with free_raw_data=False (matrix/sparse "
+                  "input) or from a file path")
+    if isinstance(raw, str):
+        from .io.parser import parse_file_lines
+        with open(raw) as f:
+            lines = [ln for ln in f.read().splitlines() if ln]
+        if ds.config.has_header:
+            lines = lines[1:]
+        # dense width fixed to the OLD model's schema (predictor.hpp)
+        w = max(gb.max_feature_idx + 2, inner.label_idx + 1)
+        _, feats, _ = parse_file_lines(lines, inner.label_idx,
+                                       dense_cols=w)
+        scores = gb.predict_raw(feats)                     # [K, N]
+    elif _is_sparse(raw):
+        out = old.predict(raw, raw_score=True)   # [N] or [N, K]
+        scores = out.T if getattr(out, "ndim", 1) == 2 else out
+    else:
+        scores = gb.predict_raw(_as_dense(raw))            # [K, N]
+    # class-major flat layout, like metadata init-score files
+    ds.set_init_score(np.asarray(scores).reshape(-1))
+
+
+def _as_old_booster(init_model: Union[str, Booster],
+                    params: Dict) -> Booster:
+    if isinstance(init_model, Booster):
+        return init_model
+    text = str(init_model)
+    if "\n" in text:
+        # a multi-line string IS the model text (model_to_string
+        # output), not a path — open() on it would raise ENOENT/
+        # ENAMETOOLONG instead of loading the model
+        return Booster(params=dict(params), model_str=text)
+    return Booster(params=dict(params), model_file=text)
+
+
 def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
           valid_sets: Sequence[Dataset] = (),
           valid_names: Optional[Sequence[str]] = None,
           fobj: Optional[Callable] = None,
           early_stopping_rounds: Optional[int] = None,
-          verbose_eval: Union[bool, int] = True) -> Booster:
-    """Train-loop driver (Application::Train, application.cpp:218-236)."""
+          verbose_eval: Union[bool, int] = True,
+          init_model: Optional[Union[str, Booster]] = None) -> Booster:
+    """Train-loop driver (Application::Train, application.cpp:218-236).
+
+    init_model warm-starts training two ways, routed on the file's
+    actual format:
+
+      * a CHECKPOINT archive (Booster.save_checkpoint): bit-exact
+        continuation — the restored state continues to num_boost_round
+        TOTAL rounds, byte-identical to an uninterrupted run of the
+        same length (the resume=auto mechanism, resilience/snapshot);
+        the checkpoint must have been written under this config and
+        dataset (fingerprint-checked).
+      * a model TEXT file / Booster / model string: the reference's
+        continued-training semantics (re-boost from predicted init
+        scores) — num_boost_round NEW trees are grown on top and the
+        saved model contains old + new trees.  Works across datasets
+        (the refresh pipeline's incremental-boosting path); see
+        PARITY.md §5 for the deliberate divergence from a from-scratch
+        run.
+    """
     p = dict(params)
     if early_stopping_rounds is not None:
         p["early_stopping_round"] = early_stopping_rounds
@@ -624,7 +694,27 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                                 "num_tree", "num_trees", "num_round",
                                 "num_rounds")):
         p["num_iterations"] = num_boost_round
+    init_ckpt: Optional[str] = None
+    old_booster: Optional[Booster] = None
+    if init_model is not None:
+        from .resilience.snapshot import is_checkpoint_file
+        if isinstance(init_model, str) \
+                and is_checkpoint_file(init_model):
+            init_ckpt = init_model
+        else:
+            # init scores must be installed BEFORE Booster construction:
+            # the objective reads metadata.init_score at init time
+            old_booster = _as_old_booster(init_model, params)
+            _seed_init_scores(old_booster, train_set)
+            for vs in valid_sets:
+                _seed_init_scores(old_booster, vs)
     booster = Booster(p, train_set=train_set)
+    if old_booster is not None:
+        # carry the already-trained trees so saved models hold the full
+        # ensemble (cli.init_train's continued-training block)
+        gb = booster._gbdt
+        gb.models = list(old_booster._gbdt.models)
+        gb.num_used_model = len(gb.models) // gb.num_class
     names = list(valid_names or
                  ["valid_%d" % i for i in range(len(valid_sets))])
     for ds, name in zip(valid_sets, names):
@@ -645,9 +735,20 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     # past num_boost_round would skip the loop and return extra trees
     snaps = SnapshotManager.from_config(gbdt.config,
                                         max_iteration=num_boost_round)
-    done = 0
+    if init_ckpt is not None:
+        # bit-exact warm start: the loaded checkpoint IS the resume
+        # mechanism (fingerprint-checked against this config/dataset).
+        # A newer snapshot from THIS run's snapshot_dir still wins
+        # below — the warm-start checkpoint is the base, not the tip.
+        booster.load_checkpoint(init_ckpt)
+        if gbdt.iter > num_boost_round:
+            log.fatal("init_model=%s holds %d iterations, beyond "
+                      "num_boost_round=%d — the model would silently "
+                      "contain more rounds than requested"
+                      % (init_ckpt, int(gbdt.iter), num_boost_round))
     if snaps is not None:
-        done = snaps.maybe_resume(gbdt)
+        snaps.maybe_resume(gbdt)
+    done = int(gbdt.iter)
     stop = False
     while done < num_boost_round and not stop:
         if fobj is not None:

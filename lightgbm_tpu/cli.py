@@ -65,6 +65,12 @@ class Application:
             # ingest lanes must not pay a backend init
             from .ingest.writer import run_ingest_cli
             run_ingest_cli(self.config)
+        elif self.config.task == "refresh":
+            # continuous train->deploy agent (refresh/agent.py):
+            # jax-free supervisor lane like the serve front-end — it
+            # only watches, spawns retrain subprocesses and talks HTTP
+            from .refresh.agent import run_refresh_cli
+            run_refresh_cli(self.config)
         elif self.config.task == "serve":
             # warm-model HTTP prediction service (serving/): jax imports
             # lazily inside the forest only when its engine is selected,
@@ -116,12 +122,21 @@ class Application:
             sync_config_by_min(cfg)
             check_config_fingerprint(cfg)
         self.boosting_old: Optional[GBDT] = None
+        self._warm_start_ckpt: Optional[str] = None
         if cfg.input_model:
-            # continued training (application.cpp:106-180): predict init
-            # scores with the old model
-            self.boosting_old = GBDT(cfg, None, None)
-            with open(cfg.input_model) as f:
-                self.boosting_old.load_model_from_string(f.read())
+            from .resilience.snapshot import is_checkpoint_file
+            if is_checkpoint_file(cfg.input_model):
+                # a CHECKPOINT archive: bit-exact warm start via the
+                # resume mechanism (loaded below, once the booster has
+                # its datasets) — continues to num_iterations TOTAL
+                self._warm_start_ckpt = cfg.input_model
+            else:
+                # model TEXT: continued training (application.cpp:
+                # 106-180) — predict init scores with the old model,
+                # then grow num_iterations NEW trees on top
+                self.boosting_old = GBDT(cfg, None, None)
+                with open(cfg.input_model) as f:
+                    self.boosting_old.load_model_from_string(f.read())
 
         self.objective = create_objective(cfg)
         start = time.time()
@@ -197,6 +212,21 @@ class Application:
         # must run AFTER the booster has its datasets/valid sets so the
         # restored state lands in the exact structures training uses
         from .resilience.snapshot import SnapshotManager
+        if self._warm_start_ckpt is not None:
+            # bit-exact warm start (init_model=<checkpoint>): the base
+            # state loads first; a newer snapshot from THIS run's
+            # snapshot_dir still wins below (it continues the same
+            # lineage — load_checkpoint fingerprint-checks both)
+            self.boosting.load_checkpoint(self._warm_start_ckpt)
+            if self.boosting.iter > cfg.num_iterations:
+                log.fatal("input_model=%s holds %d iterations, beyond "
+                          "num_iterations=%d — the model would "
+                          "silently contain more rounds than requested"
+                          % (self._warm_start_ckpt,
+                             int(self.boosting.iter),
+                             cfg.num_iterations))
+            log.info("Warm start from checkpoint %s (iteration %d)"
+                     % (self._warm_start_ckpt, int(self.boosting.iter)))
         self.snapshots = SnapshotManager.from_config(
             cfg, self.rank, self.num_machines)
         if self.snapshots is not None:
@@ -206,12 +236,17 @@ class Application:
     def _set_init_scores(self, ds, fname: str) -> None:
         from .io.parser import parse_file_lines
 
-        with open(fname) as f:
-            # non-empty = any character, matching the native scanner and
-            # the loader's row counting (a whitespace-only line is a row)
-            lines = [ln for ln in f.read().splitlines() if ln]
-        if self.config.has_header:
-            lines = lines[1:]
+        lines: List[str] = []
+        for src in self._init_score_sources(fname):
+            with open(src) as f:
+                # non-empty = any character, matching the native
+                # scanner and the loader's row counting (a
+                # whitespace-only line is a row)
+                src_lines = [ln for ln in f.read().splitlines() if ln]
+            if self.config.has_header:
+                # per-source: every drop file carries its own header
+                src_lines = src_lines[1:]
+            lines.extend(src_lines)
         # dense width fixed to the OLD model's schema, like the
         # reference's Predictor-based init-score pass (predictor.hpp)
         w = max(self.boosting_old.max_feature_idx + 2, ds.label_idx + 1)
@@ -224,6 +259,29 @@ class Application:
             feats = feats[ds.local_rows]
         raw = self.boosting_old.predict_raw(feats)   # [K, N_local]
         ds.metadata.init_score = raw.reshape(-1).astype(np.float64)
+
+    def _init_score_sources(self, fname: str) -> List[str]:
+        """The text files whose rows (in order) make up `fname`'s rows:
+        the file itself, or — when training continues over a freshly
+        INGESTED shard directory (the refresh pipeline's incremental-
+        boosting lane) — the manifest's source files.  The shard dir
+        only holds BINNED values; the init-score pass predicts on raw
+        features, so the sources must still exist."""
+        from .ingest.manifest import (is_manifest_path, load_manifest,
+                                      manifest_dir)
+        if not is_manifest_path(fname):
+            return [fname]
+        m = load_manifest(manifest_dir(fname))
+        if m is None:
+            log.fatal("continued training from %s: no readable "
+                      "manifest (re-run task=ingest)" % fname)
+        missing = [s for s in m.sources if not os.path.isfile(s)]
+        if missing:
+            log.fatal("continued training from %s needs the original "
+                      "text sources to predict init scores (shards "
+                      "hold binned values only), but these moved: %s"
+                      % (fname, ", ".join(missing)))
+        return list(m.sources)
 
     def train(self) -> None:
         from .models.gbdt import NO_LIMIT

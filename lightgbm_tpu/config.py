@@ -104,7 +104,8 @@ class Config:
     dataclass is the idiomatic Python equivalent)."""
 
     # -- task / top-level ------------------------------------------------
-    task: str = "train"                   # train | predict | serve | ingest
+    task: str = "train"                   # train | predict | serve |
+    #                                       ingest | refresh
     num_threads: int = 0
     boosting_type: str = "gbdt"           # gbdt | dart
     objective: str = "regression"         # regression | binary | multiclass | lambdarank
@@ -326,6 +327,58 @@ class Config:
     #                                       byte-identical models either
     #                                       way)
 
+    # -- continuous refresh (task=refresh; refresh/agent.py) -------------
+    refresh_drop_dir: str = ""            # watched drop directory: new
+    #                                       training text files landing
+    #                                       here trigger retrain cycles
+    refresh_work_dir: str = ""            # agent scratch/state dir
+    #                                       ("" = <drop_dir>/.refresh)
+    refresh_serve_url: str = ""           # base URL of the serving
+    #                                       fleet the agent deploys to
+    #                                       (e.g. http://127.0.0.1:8080)
+    refresh_eval_data: str = ""           # held-out labeled rows
+    #                                       (task=predict data format)
+    #                                       mirrored to champion AND
+    #                                       challenger for shadow eval
+    refresh_period_s: float = 30.0        # min seconds between cycles
+    refresh_poll_s: float = 0.5           # drop-dir scan cadence; a
+    #                                       file is offered only once
+    #                                       its (size, mtime) held
+    #                                       still across two scans
+    refresh_rounds: int = 0               # boosting rounds per retrain
+    #                                       (0 = num_iterations)
+    refresh_min_gain: float = 0.0         # challenger must beat the
+    #                                       champion's shadow-eval loss
+    #                                       by more than this to be
+    #                                       promoted (ties reject)
+    refresh_deadline_s: float = 120.0     # per-step overall deadline
+    #                                       (train / push / eval /
+    #                                       promote each retry with
+    #                                       backoff under it)
+    refresh_breaker_threshold: int = 3    # consecutive failed cycles
+    #                                       before the agent's circuit
+    #                                       breaker opens (champion
+    #                                       keeps serving)
+    refresh_cooldown_s: float = 30.0      # how long an open breaker
+    #                                       skips cycles before the
+    #                                       next (half-open) attempt
+    refresh_max_cycles: int = 0           # exit after N completed
+    #                                       cycle attempts (0 = run
+    #                                       until SIGTERM — production;
+    #                                       N is for smokes/tests)
+    refresh_train_args: str = ""          # extra space-separated
+    #                                       key=value args forwarded to
+    #                                       the retrain subprocess
+    refresh_ingest: bool = False          # route each cycle's drop
+    #                                       data through task=ingest
+    #                                       and retrain from the shard
+    #                                       directory (out-of-core
+    #                                       lane) instead of the text
+    #                                       file directly
+    refresh_status_port: int = 0          # agent /metrics + /healthz
+    #                                       port (0 = pick a free port,
+    #                                       -1 = disabled)
+
     # -- fault tolerance (resilience/) -----------------------------------
     snapshot_period: int = 0              # snapshot every N iterations
     #                                       (0 = off); requires
@@ -383,6 +436,8 @@ class Config:
                 c.task = "serve"
             elif t in ("ingest", "ingestion"):
                 c.task = "ingest"
+            elif t == "refresh":
+                c.task = "refresh"
             else:
                 log.fatal("Unknown task type %s" % t)
         if "boosting_type" in params:
@@ -514,6 +569,21 @@ class Config:
         set_int("ingest_shard_rows")
         set_int("ingest_workers")
         set_int("ingest_prefetch")
+        set_str("refresh_drop_dir")
+        set_str("refresh_work_dir")
+        set_str("refresh_serve_url")
+        set_str("refresh_eval_data")
+        set_float("refresh_period_s")
+        set_float("refresh_poll_s")
+        set_int("refresh_rounds")
+        set_float("refresh_min_gain")
+        set_float("refresh_deadline_s")
+        set_int("refresh_breaker_threshold")
+        set_float("refresh_cooldown_s")
+        set_int("refresh_max_cycles")
+        set_str("refresh_train_args")
+        set_bool("refresh_ingest")
+        set_int("refresh_status_port")
         set_int("snapshot_period")
         set_str("snapshot_dir")
         set_int("snapshot_keep")
@@ -549,6 +619,39 @@ class Config:
             log.fatal("ingest_shard_rows must be >= 0 (0 = auto)")
         if c.ingest_workers < 0:
             log.fatal("ingest_workers must be >= 0 (0 = auto)")
+        if c.refresh_period_s < 0:
+            log.fatal("refresh_period_s must be >= 0")
+        if c.refresh_poll_s <= 0:
+            log.fatal("refresh_poll_s must be > 0")
+        if c.refresh_rounds < 0:
+            log.fatal("refresh_rounds must be >= 0 (0 = num_iterations)")
+        if c.refresh_min_gain < 0:
+            # a negative tolerance would promote a challenger whose
+            # shadow loss is strictly WORSE — violating the invariant
+            # that a losing challenger is never made default
+            log.fatal("refresh_min_gain must be >= 0")
+        if c.refresh_deadline_s <= 0:
+            log.fatal("refresh_deadline_s must be > 0")
+        if c.refresh_breaker_threshold < 1:
+            log.fatal("refresh_breaker_threshold must be >= 1")
+        if c.refresh_cooldown_s < 0:
+            log.fatal("refresh_cooldown_s must be >= 0")
+        if c.refresh_max_cycles < 0:
+            log.fatal("refresh_max_cycles must be >= 0 (0 = forever)")
+        if c.refresh_status_port < -1:
+            log.fatal("refresh_status_port must be >= -1 "
+                      "(-1 = disabled, 0 = pick a free port)")
+        if c.task == "refresh":
+            if not c.refresh_drop_dir:
+                log.fatal("task=refresh requires refresh_drop_dir")
+            if not c.refresh_serve_url:
+                log.fatal("task=refresh requires refresh_serve_url")
+            if not c.refresh_eval_data:
+                log.fatal("task=refresh requires refresh_eval_data "
+                          "(held-out rows for shadow eval)")
+            if not c.input_model:
+                log.fatal("task=refresh requires input_model (the "
+                          "starting champion)")
         if c.snapshot_period < 0:
             log.fatal("snapshot_period must be >= 0")
         if c.snapshot_keep < 0:

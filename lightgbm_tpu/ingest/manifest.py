@@ -24,7 +24,7 @@ __jax_free__ = True
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis.contracts import contract
 from ..config import Config
@@ -187,8 +187,47 @@ def manifest_dir(path: str) -> str:
     return path
 
 
+#: file suffixes snapshot_sources treats as candidate training data —
+#: the formats the text parser sniffs (io/parser) plus the generic ones
+SOURCE_SUFFIXES: Tuple[str, ...] = (".tsv", ".csv", ".txt", ".data",
+                                    ".svm", ".libsvm")
+
+
+def snapshot_sources(dirpath: str,
+                     suffixes: Sequence[str] = SOURCE_SUFFIXES
+                     ) -> Dict[str, Tuple[int, int]]:
+    """One (size, mtime_ns) stat snapshot of the candidate data files
+    directly under `dirpath` — the drop-directory watch primitive the
+    refresh agent polls (the same identity per file that
+    source_fingerprint bakes into manifests, at ns precision).  The
+    watcher offers a file downstream only once its entry holds STILL
+    across two consecutive snapshots: a writer mid-copy keeps moving
+    size/mtime, so half-written drops are never ingested.  Dotfiles
+    and non-data suffixes are invisible (work/state files live
+    alongside drops without triggering cycles)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(".") \
+                or not any(name.endswith(s) for s in suffixes):
+            continue
+        path = os.path.join(dirpath, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue          # raced a delete: absent next snapshot too
+        if not os.path.isfile(path):
+            continue
+        out[path] = (st.st_size, st.st_mtime_ns)
+    return out
+
+
 __all__ = ["MANIFEST_NAME", "PLAN_NAME", "BINS_NAME", "FP_KEYS",
-           "Manifest", "ManifestError", "config_fingerprint",
-           "source_fingerprint", "fingerprint_diff", "shard_name",
-           "shard_meta_name", "save_manifest", "load_manifest",
-           "is_manifest_path", "manifest_dir"]
+           "SOURCE_SUFFIXES", "Manifest", "ManifestError",
+           "config_fingerprint", "source_fingerprint",
+           "fingerprint_diff", "shard_name", "shard_meta_name",
+           "save_manifest", "load_manifest", "is_manifest_path",
+           "manifest_dir", "snapshot_sources"]

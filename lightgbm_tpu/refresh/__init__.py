@@ -1,0 +1,18 @@
+"""Continuous train->deploy: online model refresh with shadow-eval
+gating (refresh/agent.py).
+
+The package closes the loop the other subsystems left open: ingest
+streams data in (PR 9), training warm-starts from the champion
+(init_model, api/cli), the serving fleet hot-swaps models behind one
+port (PR 8) — the refresh agent wires them into the production story
+where data arrives, the model retrains, the fleet updates, and users
+never notice.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+from .agent import RefreshAgent, run_refresh_cli
+
+__all__ = ["RefreshAgent", "run_refresh_cli"]

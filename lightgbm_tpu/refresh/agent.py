@@ -1,0 +1,806 @@
+"""The jax-free deploy agent: watch -> retrain -> shadow-eval -> promote.
+
+`task=refresh` runs this agent next to the PR 8 serving fleet (same
+jax-free supervisor profile as serving/frontend.py: it only watches a
+directory, spawns subprocesses and talks HTTP — the heavy lifting
+happens in a fresh `task=train` interpreter per cycle and inside the
+serving workers).  One refresh cycle:
+
+  1. WATCH    new data files land in `refresh_drop_dir`; a file is
+              picked up only once its (size, mtime) held still across
+              two polls (half-written drops never train).
+  2. RETRAIN  a `task=train` subprocess warm-starts from the current
+              champion (`input_model=` continued training, optionally
+              through a `task=ingest` shard pass first) and writes the
+              challenger model atomically.
+  3. PUSH     the challenger enters the serving fleet NON-default
+              (POST /reload {"model":.., "default": false}) — on every
+              SO_REUSEPORT worker, confirmed by sha via /healthz.
+  4. SHADOW   the held-out eval rows are mirrored through the batcher
+              to champion (default route) AND challenger
+              (/predict?model=) concurrently; both answer the SAME
+              bytes-in, and the agent scores both answer sets against
+              the labels.
+  5. PROMOTE  only on a metric win (lower loss by > refresh_min_gain):
+              POST /reload {"model": challenger} repoints the default
+              on every worker.  A losing or erroring challenger is
+              demoted (never made default) and counted.
+
+Hardening: every network/subprocess step runs under a deadline with
+the shared resilience/backoff retry curve; the named faultpoints
+`refresh.train_spawn`, `refresh.eval`, `deploy.push` and
+`deploy.promote` make each seam chaos-testable (an injected `raise` is
+a cycle FAILURE, never retried away — kill schedules prove a dead
+agent leaves the fleet serving the champion byte-identically, and the
+rerun converges).  Consecutive cycle failures past
+`refresh_breaker_threshold` open a circuit breaker: the agent stops
+retraining for `refresh_cooldown_s` and the champion keeps serving.
+Durable agent state (consumed drops, champion lineage, outcome
+counters) lives in one atomically-written JSON file, so a SIGKILL at
+any instant reruns the interrupted cycle deterministically.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..ingest.manifest import snapshot_sources
+from ..models.tree import parse_model_text
+from ..resilience.atomic import atomic_write_bytes, atomic_writer
+from ..resilience.backoff import Backoff, retry_with_backoff
+from ..resilience.faults import FaultInjected, faultpoint
+from ..utils import log
+
+STATE_NAME = "refresh_state.json"
+
+#: training keys the agent forwards verbatim to the retrain (and
+#: ingest) subprocess — the operator writes ONE conf holding both the
+#: refresh_* keys and the training hyper-parameters, exactly like
+#: task=train would read it.  `refresh_train_args` appends after these,
+#: so explicit extras win (CLI precedence).
+FORWARD_KEYS: Tuple[str, ...] = (
+    "objective", "boosting_type", "num_class", "num_leaves",
+    "max_depth", "max_bin", "min_data_in_leaf",
+    "min_sum_hessian_in_leaf", "learning_rate", "lambda_l1",
+    "lambda_l2", "min_gain_to_split", "feature_fraction",
+    "feature_fraction_seed", "bagging_fraction", "bagging_freq",
+    "bagging_seed", "data_random_seed", "drop_rate", "drop_seed",
+    "sigmoid", "label_column", "weight_column", "group_column",
+    "ignore_column", "bin_construct_sample_cnt", "has_header",
+    "device_type", "hist_impl", "hist_dtype",
+)
+
+#: objectives the shadow eval scores with a proper loss; anything else
+#: falls back to L2 on the raw scores with a warning (once)
+EVAL_LOSSES = ("binary", "multiclass", "regression")
+
+
+class CycleError(RuntimeError):
+    """One refresh cycle failed (retrain, push, eval or promote); the
+    champion keeps serving and the drop files stay unconsumed."""
+
+
+def _fmt_param(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+_SHA_CACHE: Dict[str, Tuple[Tuple[int, int], str]] = {}
+
+
+def _sha256_file_cached(path: str) -> str:
+    """_sha256_file memoized by (size, mtime_ns): a Prometheus scrape
+    loop must not stream + hash a hundreds-of-MB model file every 10s
+    for a value that only changes at promotion."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return "missing"
+    key = (st.st_size, st.st_mtime_ns)
+    hit = _SHA_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    sha = _sha256_file(path)
+    _SHA_CACHE[path] = (key, sha)
+    return sha
+
+
+def _tail(text: str, lines: int = 15) -> str:
+    return "\n".join(text.splitlines()[-lines:])
+
+
+# ---------------------------------------------------------------------------
+# shadow-eval scoring (host-side, numpy only)
+# ---------------------------------------------------------------------------
+
+def parse_label_column(body: bytes, label_idx: int) -> np.ndarray:
+    """Labels from held-out rows in the task=predict data-file format
+    (CSV/TSV/LibSVM, sniffed with the shared io/parser rule)."""
+    from ..io.parser import sniff_format
+    chunks = iter((body,))
+    fmt, sep = sniff_format(lambda: next(chunks, b""))
+    labels: List[float] = []
+    for ln in body.decode("utf-8", "replace").splitlines():
+        if not ln.strip("\r"):
+            continue
+        if fmt == "libsvm":
+            labels.append(float(ln.split(None, 1)[0]))
+        else:
+            labels.append(float(ln.split(sep)[label_idx]))
+    return np.asarray(labels, dtype=np.float64)
+
+
+def parse_score_rows(body: bytes) -> np.ndarray:
+    """A /predict?mode=raw response -> [N, K] scores (one line per
+    row, K whitespace-separated values — the task=predict format)."""
+    rows = [[float(t) for t in ln.split()]
+            for ln in body.decode("utf-8", "replace").splitlines()
+            if ln.strip()]
+    if not rows:
+        return np.zeros((0, 1), dtype=np.float64)
+    return np.asarray(rows, dtype=np.float64)
+
+
+def shadow_loss(scores: np.ndarray, labels: np.ndarray,
+                objective: str, sigmoid: float = 1.0) -> float:
+    """Lower-is-better loss of raw scores against labels: binary
+    logloss, multiclass softmax logloss, or L2 (regression and the
+    fallback for objectives without a per-row loss here)."""
+    if scores.shape[0] != labels.shape[0]:
+        raise CycleError("shadow eval: %d score rows for %d labels"
+                         % (scores.shape[0], labels.shape[0]))
+    if scores.shape[0] == 0:
+        raise CycleError("shadow eval: empty eval set")
+    eps = 1e-15
+    if objective == "binary":
+        p = 1.0 / (1.0 + np.exp(-sigmoid * scores[:, 0]))
+        p = np.clip(p, eps, 1.0 - eps)
+        y = (labels > 0).astype(np.float64)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if objective == "multiclass":
+        z = scores - scores.max(axis=1, keepdims=True)
+        ez = np.exp(z)
+        p = ez / ez.sum(axis=1, keepdims=True)
+        idx = labels.astype(np.int64)
+        if (idx < 0).any() or (idx >= scores.shape[1]).any():
+            raise CycleError("shadow eval: label outside [0, %d)"
+                             % scores.shape[1])
+        pt = np.clip(p[np.arange(len(idx)), idx], eps, 1.0)
+        return float(-np.mean(np.log(pt)))
+    return float(np.mean((scores[:, 0] - labels) ** 2))
+
+
+# ---------------------------------------------------------------------------
+# the agent
+# ---------------------------------------------------------------------------
+
+class RefreshAgent:
+    """One continuous train->deploy loop against one serving fleet."""
+
+    def __init__(self, cfg: Config):
+        if not cfg.refresh_drop_dir:
+            log.fatal("RefreshAgent needs refresh_drop_dir")
+        if not cfg.refresh_serve_url:
+            log.fatal("RefreshAgent needs refresh_serve_url")
+        if not cfg.refresh_eval_data:
+            log.fatal("RefreshAgent needs refresh_eval_data")
+        if not cfg.input_model:
+            log.fatal("RefreshAgent needs input_model (the starting "
+                      "champion)")
+        if cfg.faults:
+            # deterministic fault injection: same arming rule as
+            # cli.Application / api.Booster (config wins over env)
+            from ..resilience.faults import configure
+            configure(cfg.faults)
+        self.cfg = cfg
+        self.drop_dir = cfg.refresh_drop_dir
+        self.work_dir = (cfg.refresh_work_dir
+                         or os.path.join(cfg.refresh_drop_dir,
+                                         ".refresh"))
+        self.serve_url = cfg.refresh_serve_url.rstrip("/")
+        self.deadline_s = float(cfg.refresh_deadline_s)
+        self.min_gain = float(cfg.refresh_min_gain)
+        self.rounds = int(cfg.refresh_rounds or cfg.num_iterations)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._data_event = threading.Event()
+        self._pending: Dict[str, Tuple[int, int]] = {}
+        self._watcher: Optional[threading.Thread] = None
+        self._status_httpd: Optional[ThreadingHTTPServer] = None
+        self._status_thread: Optional[threading.Thread] = None
+        self._warned_loss_fallback = False
+        # observable state (all mutated under _lock; the status server
+        # thread renders it)
+        self.outcomes: Dict[str, int] = {"promoted": 0, "rejected": 0,
+                                         "failed": 0}
+        self.consecutive_failures = 0
+        self.breaker_open_until = 0.0
+        self.last_losses: Optional[Tuple[float, float]] = None
+        self.last_cycle_at = 0.0
+        self.cycle = 0
+        self.champion = cfg.input_model
+        self.consumed: Dict[str, List[int]] = {}
+        os.makedirs(self.work_dir, exist_ok=True)
+        self._load_state()
+        if not os.path.isfile(self.champion):
+            log.fatal("champion model %s does not exist" % self.champion)
+
+    # -- durable state --------------------------------------------------
+    @property
+    def _state_path(self) -> str:
+        return os.path.join(self.work_dir, STATE_NAME)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self._state_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        champ = doc.get("champion")
+        if champ and os.path.isfile(champ):
+            self.champion = str(champ)
+        self.cycle = int(doc.get("cycle", 0))
+        self.consumed = {str(k): [int(v[0]), int(v[1])]
+                         for k, v in dict(doc.get("consumed",
+                                                  {})).items()}
+        for k in self.outcomes:
+            self.outcomes[k] = int(doc.get("outcomes", {}).get(k, 0))
+
+    def _save_state(self) -> None:
+        with self._lock:
+            doc = {"champion": self.champion, "cycle": self.cycle,
+                   "consumed": self.consumed,
+                   "outcomes": dict(self.outcomes)}
+        atomic_write_bytes(self._state_path,
+                           (json.dumps(doc, indent=1, sort_keys=True)
+                            + "\n").encode("utf-8"), checksum=False)
+
+    # -- HTTP plumbing --------------------------------------------------
+    def _http(self, path: str, data: Optional[bytes] = None,
+              ctype: str = "application/json",
+              timeout: Optional[float] = None) -> Tuple[int, bytes]:
+        req = urllib.request.Request(
+            self.serve_url + path, data=data,
+            headers={} if data is None else {"Content-Type": ctype})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.deadline_s) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as ex:
+            body = ex.read()
+            if ex.code < 500:
+                # a client fault (bad challenger, unknown model) will
+                # not heal by retrying: fail the step immediately
+                raise CycleError("%s -> HTTP %d: %s"
+                                 % (path, ex.code,
+                                    body.decode("utf-8", "replace")
+                                    [:300])) from ex
+            raise
+
+    def _healthz(self) -> Dict[str, Any]:
+        _, body = self._http("/healthz")
+        doc = json.loads(body.decode("utf-8"))
+        if not isinstance(doc, dict):
+            raise CycleError("/healthz returned a non-object")
+        return doc
+
+    def wait_serving(self) -> None:
+        """Block until the serving fleet answers /healthz (startup
+        race: the agent and the fleet come up together)."""
+        retry_with_backoff(self._healthz, "serving fleet /healthz",
+                           deadline_s=self.deadline_s,
+                           base_s=0.2, cap_s=2.0)
+
+    # -- retrain --------------------------------------------------------
+    def _forward_params(self) -> List[str]:
+        cfg = self.cfg
+        out = ["%s=%s" % (k, _fmt_param(getattr(cfg, k)))
+               for k in FORWARD_KEYS]
+        out.append("metric=%s" % ",".join(cfg.metric))
+        if cfg.refresh_train_args:
+            out.extend(cfg.refresh_train_args.split())
+        return out
+
+    def _train_argv(self, data_path: str, out_model: str) -> List[str]:
+        return ([sys.executable, "-m", "lightgbm_tpu", "task=train",
+                 "data=" + data_path, "input_model=" + self.champion,
+                 "output_model=" + out_model,
+                 "num_iterations=%d" % self.rounds,
+                 "verbose=%d" % self.cfg.verbose]
+                + self._forward_params())
+
+    def _ingest_argv(self, data_path: str, shards_dir: str) -> List[str]:
+        cfg = self.cfg
+        return ([sys.executable, "-m", "lightgbm_tpu", "task=ingest",
+                 "data=" + data_path, "ingest_dir=" + shards_dir,
+                 "ingest_memory_budget_mb=%d"
+                 % cfg.ingest_memory_budget_mb,
+                 "ingest_shard_rows=%d" % cfg.ingest_shard_rows,
+                 "ingest_workers=%d" % cfg.ingest_workers,
+                 "verbose=%d" % cfg.verbose]
+                + self._forward_params())
+
+    def _run_subprocess(self, argv: List[str], what: str) -> None:
+        proc = subprocess.run(argv, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT,
+                              timeout=self.deadline_s)
+        out = proc.stdout.decode("utf-8", "replace")
+        if proc.returncode != 0:
+            raise CycleError("%s exited %d:\n%s"
+                             % (what, proc.returncode, _tail(out)))
+
+    def _retrain(self, data_path: str, out_model: str) -> None:
+        """Warm-start retrain subprocess (champion -> challenger),
+        retried with backoff under the step deadline.  The spawn seam
+        is `refresh.train_spawn`; an injected raise is a cycle failure
+        (give_up_on), never absorbed by a retry."""
+        if self.cfg.refresh_ingest:
+            shards = os.path.join(self.work_dir,
+                                  "cycle_%04d.shards" % self.cycle)
+            retry_with_backoff(
+                lambda: self._run_subprocess(
+                    self._ingest_argv(data_path, shards),
+                    "ingest subprocess"),
+                "cycle %d ingest" % self.cycle,
+                deadline_s=self.deadline_s, base_s=0.5, cap_s=4.0,
+                give_up_on=(FaultInjected, CycleError))
+            data_path = shards
+
+        def attempt() -> None:
+            faultpoint("refresh.train_spawn")
+            self._run_subprocess(self._train_argv(data_path, out_model),
+                                 "retrain subprocess")
+            if not os.path.isfile(out_model):
+                raise CycleError("retrain subprocess wrote no model "
+                                 "at %s" % out_model)
+
+        retry_with_backoff(attempt, "cycle %d retrain" % self.cycle,
+                           deadline_s=self.deadline_s,
+                           base_s=0.5, cap_s=4.0,
+                           give_up_on=(FaultInjected, CycleError))
+
+    # -- deploy (push / promote) ----------------------------------------
+    def _model_live(self, doc: Dict[str, Any], sha: str,
+                    as_default: bool) -> bool:
+        if as_default:
+            return bool(doc.get("model", {}).get("sha") == sha)
+        return any(m.get("warm") and m.get("sha") == sha
+                   for m in doc.get("models", ()))
+
+    def _deploy(self, path: str, make_default: bool) -> None:
+        """POST the model into the fleet and CONFIRM it landed on every
+        worker.  SO_REUSEPORT routes each connection to one worker, so
+        the push repeats (idempotent re-warm) until /healthz scrapes
+        have confirmed the sha on all `worker.count` indexes — a
+        single-process server confirms on the first scrape."""
+        sha = _sha256_file(path)
+        fp = "deploy.promote" if make_default else "deploy.push"
+        body = json.dumps({"model": path,
+                           "default": make_default}).encode("utf-8")
+        curve = Backoff(base_s=0.2, cap_s=2.0)
+        t0 = time.monotonic()
+        confirmed: Set[int] = set()
+        attempt = 0
+        last: Optional[BaseException] = None
+        while True:
+            attempt += 1
+            try:
+                faultpoint(fp)
+                self._http("/reload", data=body)
+                doc = self._healthz()
+                worker = doc.get("worker")
+                live = self._model_live(doc, sha, make_default)
+                if worker is None:
+                    if live:
+                        return
+                else:
+                    if live:
+                        confirmed.add(int(worker["index"]))
+                    if len(confirmed) >= int(worker.get("count", 1)):
+                        return
+                raise RuntimeError(
+                    "confirmed on %s so far" % (sorted(confirmed),))
+            except (FaultInjected, CycleError):
+                raise
+            except Exception as ex:
+                last = ex
+            delay = curve.delay(attempt)
+            if time.monotonic() - t0 + delay > self.deadline_s:
+                raise CycleError(
+                    "%s of %s did not confirm on every worker within "
+                    "%.1fs: %s" % (fp, path, self.deadline_s,
+                                   last)) from last
+            time.sleep(delay)
+
+    # -- shadow eval -----------------------------------------------------
+    def _mirror_predict(self, body: bytes,
+                        model: Optional[str]) -> bytes:
+        qs = "/predict?mode=raw"
+        if model is not None:
+            qs += "&model=" + urllib.parse.quote(model, safe="")
+        status, out = retry_with_backoff(
+            lambda: self._http(qs, data=body, ctype="text/plain"),
+            "shadow predict (%s)" % (model or "champion"),
+            deadline_s=self.deadline_s, base_s=0.2, cap_s=2.0,
+            give_up_on=(FaultInjected, CycleError))
+        return out
+
+    def _shadow_eval(self, challenger: str) -> Tuple[float, float]:
+        """Mirror the held-out rows to champion (default route) and
+        challenger concurrently; return (champion_loss,
+        challenger_loss).  The two requests ride the SAME bytes through
+        the production /predict path (batcher included), on named
+        daemon eval threads joined under the step deadline."""
+        faultpoint("refresh.eval")
+        with open(self.cfg.refresh_eval_data, "rb") as f:
+            body = f.read()
+        if self.cfg.has_header:
+            from ..serving.server import _strip_first_line
+            body = _strip_first_line(body)
+        if not body.strip():
+            raise CycleError("refresh_eval_data %s is empty"
+                             % self.cfg.refresh_eval_data)
+        with open(self.champion) as f:
+            header, _ = parse_model_text(f.read())
+        labels = parse_label_column(body, int(header["label_index"]))
+        results: Dict[str, Tuple[str, Any]] = {}
+
+        def fetch(tag: str, model: Optional[str]) -> None:
+            try:
+                results[tag] = ("ok", self._mirror_predict(body, model))
+            except BaseException as ex:   # re-raised on the main thread
+                results[tag] = ("err", ex)
+
+        threads = [
+            threading.Thread(target=fetch, args=("champion", None),
+                             name="lgbm-refresh-eval-champion",
+                             daemon=True),
+            threading.Thread(target=fetch, args=("challenger",
+                                                 challenger),
+                             name="lgbm-refresh-eval-challenger",
+                             daemon=True)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.deadline_s + 5.0
+        for t in threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+            if t.is_alive():
+                raise CycleError("shadow eval thread %s missed the "
+                                 "deadline" % t.name)
+        losses = {}
+        objective = self.cfg.objective
+        if objective not in EVAL_LOSSES \
+                and not self._warned_loss_fallback:
+            self._warned_loss_fallback = True
+            log.warning("shadow eval: objective %s has no per-row "
+                        "loss here; scoring raw scores with L2"
+                        % objective)
+        for tag in ("champion", "challenger"):
+            kind, val = results[tag]
+            if kind == "err":
+                raise CycleError("shadow eval (%s) failed: %s"
+                                 % (tag, val)) from val
+            losses[tag] = shadow_loss(parse_score_rows(val), labels,
+                                      objective,
+                                      sigmoid=self.cfg.sigmoid)
+        return losses["champion"], losses["challenger"]
+
+    # -- one cycle -------------------------------------------------------
+    def _stage_cycle_data(self, sources: Dict[str, Tuple[int, int]]
+                          ) -> str:
+        """Concatenate this cycle's stable drop files (sorted for
+        determinism) into one atomically-written training file."""
+        out = os.path.join(self.work_dir,
+                           "cycle_%04d.data" % self.cycle)
+        with atomic_writer(out) as f:
+            for path in sorted(sources):
+                with open(path, "rb") as src:
+                    payload = src.read()
+                f.write(payload)
+                if payload and not payload.endswith(b"\n"):
+                    f.write(b"\n")
+        return out
+
+    def run_cycle(self, sources: Dict[str, Tuple[int, int]]) -> str:
+        """One refresh cycle over `sources` (a stable snapshot_sources
+        slice).  Returns the outcome: promoted | rejected | failed.
+        Failure leaves the fleet untouched (champion serving) and the
+        sources unconsumed; the next cycle retries them."""
+        t0 = time.monotonic()
+        challenger = os.path.join(self.work_dir,
+                                  "challenger_%04d.txt" % self.cycle)
+        try:
+            data_path = self._stage_cycle_data(sources)
+            self._retrain(data_path, challenger)
+            self._deploy(challenger, make_default=False)
+            champ_loss, chall_loss = self._shadow_eval(challenger)
+            win = chall_loss < champ_loss - self.min_gain
+            with self._lock:
+                self.last_losses = (champ_loss, chall_loss)
+            if win:
+                self._deploy(challenger, make_default=True)
+                outcome = "promoted"
+            else:
+                # demotion: the challenger stays registered non-default
+                # (shadow-only); it was NEVER the default
+                outcome = "rejected"
+            log.info("refresh cycle %d: %s (champion %.6g vs "
+                     "challenger %.6g, min_gain %g) in %.1fs"
+                     % (self.cycle, outcome, champ_loss, chall_loss,
+                        self.min_gain, time.monotonic() - t0))
+        except Exception as ex:
+            with self._lock:
+                self.outcomes["failed"] += 1
+                self.consecutive_failures += 1
+                failures = self.consecutive_failures
+                if failures >= self.cfg.refresh_breaker_threshold:
+                    self.breaker_open_until = (
+                        time.monotonic() + self.cfg.refresh_cooldown_s)
+            log.warning("refresh cycle %d FAILED (%s: %s) — champion "
+                        "keeps serving%s"
+                        % (self.cycle, type(ex).__name__, ex,
+                           "; breaker OPEN for %gs"
+                           % self.cfg.refresh_cooldown_s
+                           if failures
+                           >= self.cfg.refresh_breaker_threshold
+                           else ""))
+            self._save_state()
+            return "failed"
+        with self._lock:
+            self.outcomes[outcome] += 1
+            self.consecutive_failures = 0
+            self.breaker_open_until = 0.0
+            if outcome == "promoted":
+                self.champion = challenger
+            self.consumed.update(
+                {p: [st[0], st[1]] for p, st in sources.items()})
+            self.cycle += 1
+        self._save_state()
+        return outcome
+
+    # -- breaker / scheduling -------------------------------------------
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self.breaker_open_until
+
+    def _take_pending(self) -> Dict[str, Tuple[int, int]]:
+        with self._lock:
+            pend = dict(self._pending)
+            self._pending.clear()
+            self._data_event.clear()
+        return pend
+
+    # -- watcher thread --------------------------------------------------
+    def _watch_loop(self) -> None:
+        prev: Dict[str, Tuple[int, int]] = {}
+        while not self._stop.wait(self.cfg.refresh_poll_s):
+            cur = snapshot_sources(self.drop_dir)
+            with self._lock:
+                consumed = dict(self.consumed)
+                fresh = {
+                    p: st for p, st in cur.items()
+                    if prev.get(p) == st
+                    and consumed.get(p) != [st[0], st[1]]}
+                if fresh:
+                    self._pending.update(fresh)
+                    self._data_event.set()
+            prev = cur
+
+    # -- status endpoint -------------------------------------------------
+    def _status_doc(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "status": ("cooling" if time.monotonic()
+                           < self.breaker_open_until else "ok"),
+                "champion": self.champion,
+                "cycle": self.cycle,
+                "outcomes": dict(self.outcomes),
+                "consecutive_failures": self.consecutive_failures,
+                "pending": len(self._pending),
+                "last_losses": (list(self.last_losses)
+                                if self.last_losses else None),
+            }
+
+    def render_metrics(self) -> bytes:
+        """Prometheus text: the refresh observability the /metrics
+        satellite asks for (cycle outcomes, breaker, shadow deltas)."""
+        out: List[str] = []
+        with self._lock:
+            outcomes = dict(self.outcomes)
+            failures = self.consecutive_failures
+            open_ = time.monotonic() < self.breaker_open_until
+            losses = self.last_losses
+            champion = self.champion
+        out.append("# HELP lgbm_refresh_cycles_total refresh cycles "
+                   "by outcome")
+        out.append("# TYPE lgbm_refresh_cycles_total counter")
+        for k in ("promoted", "rejected", "failed"):
+            out.append('lgbm_refresh_cycles_total{outcome="%s"} %d'
+                       % (k, outcomes[k]))
+        out.append("# HELP lgbm_refresh_breaker_open 1 while the "
+                   "agent's circuit breaker is cooling down")
+        out.append("# TYPE lgbm_refresh_breaker_open gauge")
+        out.append("lgbm_refresh_breaker_open %d" % int(open_))
+        out.append("# HELP lgbm_refresh_consecutive_failures failed "
+                   "cycles since the last success")
+        out.append("# TYPE lgbm_refresh_consecutive_failures gauge")
+        out.append("lgbm_refresh_consecutive_failures %d" % failures)
+        if losses is not None:
+            out.append("# HELP lgbm_refresh_shadow_loss last "
+                       "shadow-eval loss per contender")
+            out.append("# TYPE lgbm_refresh_shadow_loss gauge")
+            out.append('lgbm_refresh_shadow_loss{model="champion"} '
+                       "%.17g" % losses[0])
+            out.append('lgbm_refresh_shadow_loss{model="challenger"} '
+                       "%.17g" % losses[1])
+            out.append("# HELP lgbm_refresh_shadow_delta champion "
+                       "minus challenger shadow-eval loss (positive = "
+                       "challenger better)")
+            out.append("# TYPE lgbm_refresh_shadow_delta gauge")
+            out.append("lgbm_refresh_shadow_delta %.17g"
+                       % (losses[0] - losses[1]))
+        out.append("# HELP lgbm_refresh_champion the currently "
+                   "promoted model")
+        out.append("# TYPE lgbm_refresh_champion gauge")
+        out.append('lgbm_refresh_champion{path="%s",sha="%s"} 1'
+                   % (champion, _sha256_file_cached(champion)[:12]))
+        return ("\n".join(out) + "\n").encode("utf-8")
+
+    def _start_status_server(self) -> None:
+        if self.cfg.refresh_status_port < 0:
+            return
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                log.debug("refresh status: " + fmt % args)
+
+            def do_GET(self) -> None:
+                path = urllib.parse.urlparse(self.path).path
+                if path == "/metrics":
+                    body = agent.render_metrics()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = (json.dumps(agent._status_doc())
+                            + "\n").encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._status_httpd = ThreadingHTTPServer(
+            ("127.0.0.1", max(0, self.cfg.refresh_status_port)),
+            Handler)
+        self._status_httpd.daemon_threads = True
+        self._status_thread = threading.Thread(
+            target=self._status_httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="lgbm-refresh-status", daemon=True)
+        self._status_thread.start()
+        log.info("refresh agent status on http://127.0.0.1:%d"
+                 % self._status_httpd.server_address[1])
+
+    @property
+    def status_url(self) -> Optional[str]:
+        if self._status_httpd is None:
+            return None
+        return ("http://127.0.0.1:%d"
+                % self._status_httpd.server_address[1])
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        """Start the watcher + status threads (named daemons; joined
+        on the SIGTERM drain)."""
+        self.wait_serving()
+        self._start_status_server()
+        self._watcher = threading.Thread(target=self._watch_loop,
+                                         name="lgbm-refresh-watch",
+                                         daemon=True)
+        self._watcher.start()
+        log.info("refresh agent watching %s -> fleet %s (champion %s)"
+                 % (self.drop_dir, self.serve_url, self.champion))
+
+    def shutdown(self) -> None:
+        """Drain: stop the loop, join the watcher, stop the status
+        server — every named agent thread exits."""
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(10.0)
+            self._watcher = None
+        if self._status_httpd is not None:
+            self._status_httpd.shutdown()
+            self._status_httpd.server_close()
+            self._status_httpd = None
+        if self._status_thread is not None:
+            self._status_thread.join(10.0)
+            self._status_thread = None
+
+    def run_forever(self) -> None:
+        """Supervise cycles until SIGTERM/SIGINT (or
+        refresh_max_cycles attempts — smokes/tests)."""
+        stop_sig = threading.Event()
+
+        def _on_signal(signum: int, frame: Any) -> None:
+            log.info("Signal %d: draining refresh agent..." % signum)
+            stop_sig.set()
+            self._stop.set()
+
+        prev: Dict[int, Any] = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, _on_signal)
+            except ValueError:       # not on the main thread
+                pass
+        attempts = 0
+        try:
+            while not stop_sig.is_set():
+                self._data_event.wait(timeout=0.2)
+                if stop_sig.is_set():
+                    break
+                if not self._pending:
+                    continue
+                if self.breaker_open():
+                    time.sleep(min(0.5, self.cfg.refresh_cooldown_s))
+                    continue
+                since = time.monotonic() - self.last_cycle_at
+                if self.last_cycle_at \
+                        and since < self.cfg.refresh_period_s:
+                    time.sleep(min(0.5,
+                                   self.cfg.refresh_period_s - since))
+                    continue
+                pending = self._take_pending()
+                if not pending:
+                    continue
+                self.last_cycle_at = time.monotonic()
+                self.run_cycle(pending)
+                attempts += 1
+                if self.cfg.refresh_max_cycles \
+                        and attempts >= self.cfg.refresh_max_cycles:
+                    log.info("refresh_max_cycles=%d reached, exiting"
+                             % self.cfg.refresh_max_cycles)
+                    break
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            self.shutdown()
+            log.info("Refresh agent drained, exiting")
+
+
+def run_refresh_cli(cfg: Config) -> None:
+    """CLI entry (task=refresh)."""
+    agent = RefreshAgent(cfg)
+    agent.start()
+    agent.run_forever()

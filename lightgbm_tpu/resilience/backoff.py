@@ -1,0 +1,131 @@
+"""One exponential-backoff curve for every retry loop in the tree.
+
+Before this module three copies of the same idea had drifted apart:
+the distributed connect loop (resilience/net.connect_with_retry), the
+serving front-end's worker respawn throttle (serving/frontend.py) and
+the refresh agent's deploy retries each re-derived "double the delay,
+cap it" with their own constants and their own edge cases.  One curve,
+declared once:
+
+    delay(attempt) = min(base * factor**(attempt-1), cap)   (attempt >= 1)
+
+plus an optional SEEDED full-jitter term — randomness, where wanted,
+comes from the project's own mt19937 stream so a chaos schedule that
+kills attempt N kills attempt N on every run (no ambient RNG, no wall
+clock in the curve itself; GL005's rule).  Jitter defaults OFF: the
+deterministic curve is the parity-friendly default.
+
+`retry_with_backoff` is the loop shape net.connect_with_retry
+established (and now shares): retry under an overall deadline, give up
+when the NEXT sleep would cross it, chain the last error.
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from ..utils import log
+from ..utils.mt19937 import Mt19937Random
+
+
+class Backoff:
+    """Deterministic exponential backoff curve with bounded delays.
+
+    delay(attempt) for attempt = 1, 2, 3, ... walks base, base*factor,
+    base*factor^2, ... capped at `cap_s`.  With `jitter` in (0, 1] the
+    delay keeps a (1 - jitter) deterministic floor and draws the rest
+    from a SEEDED mt19937 stream (full jitter at jitter=1.0) — seeded
+    so retry storms decorrelate across processes (seed on the rank/pid)
+    while any single process replays the exact same delays run to run.
+    """
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0,
+                 factor: float = 2.0, jitter: float = 0.0,
+                 seed: int = 0):
+        if base_s <= 0:
+            raise ValueError("base_s must be > 0")
+        if cap_s < base_s:
+            raise ValueError("cap_s must be >= base_s")
+        if factor < 1.0:
+            raise ValueError("factor must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.factor = float(factor)
+        self.jitter = float(jitter)
+        self._rng: Optional[Mt19937Random] = (
+            Mt19937Random(seed) if jitter > 0.0 else None)
+
+    def delay(self, attempt: int) -> float:
+        """Seconds to wait after the `attempt`-th failure (1-based).
+        attempt < 1 clamps to 1 so callers can feed raw counters."""
+        n = max(1, int(attempt))
+        # cap the exponent first: factor**n overflows floats long
+        # before any real retry loop gets there
+        d = self.base_s
+        for _ in range(n - 1):
+            d *= self.factor
+            if d >= self.cap_s:
+                d = self.cap_s
+                break
+        if self._rng is not None and d > 0:
+            # full-jitter fraction from the seeded stream: floor +
+            # uniform draw over the jittered remainder
+            floor = d * (1.0 - self.jitter)
+            frac = self._rng.next_double()
+            d = floor + (d - floor) * frac
+        return d
+
+
+def retry_with_backoff(fn: Callable[[], Any], what: str,
+                       deadline_s: float = 120.0,
+                       base_s: float = 0.5, cap_s: float = 8.0,
+                       factor: float = 2.0,
+                       retry_on: Tuple[Type[BaseException], ...]
+                       = (Exception,),
+                       give_up_on: Tuple[Type[BaseException], ...]
+                       = (),
+                       sleep: Callable[[float], None] = time.sleep,
+                       ) -> Any:
+    """Run `fn()` until it succeeds or the overall deadline expires.
+
+    The loop shape shared by connect_with_retry and the refresh agent:
+    each failure sleeps the Backoff curve's next delay, giving up (and
+    re-raising the LAST error, chained) when elapsed + next-delay would
+    cross `deadline_s`.  Exceptions outside `retry_on` — or inside
+    `give_up_on`, which wins (injected chaos faults, typed client
+    errors) — propagate immediately: a "this can never succeed" error
+    must not burn the deadline retrying."""
+    curve = Backoff(base_s=base_s, cap_s=cap_s, factor=factor)
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except retry_on as ex:
+            if give_up_on and isinstance(ex, give_up_on):
+                raise
+            last = ex
+        delay = curve.delay(attempt)
+        elapsed = time.monotonic() - t0
+        if elapsed + delay > deadline_s:
+            raise RetryDeadline(
+                "%s failed after %d attempt(s) over %.1fs (deadline "
+                "%.1fs): %s" % (what, attempt, elapsed, deadline_s,
+                                last)) from last
+        log.warning("%s attempt %d failed (%s); retrying in %.1fs"
+                    % (what, attempt, last, delay))
+        sleep(delay)
+
+
+class RetryDeadline(RuntimeError):
+    """retry_with_backoff exhausted its overall deadline (the last
+    attempt's error is chained as __cause__)."""
+
+
+__all__ = ["Backoff", "RetryDeadline", "retry_with_backoff"]

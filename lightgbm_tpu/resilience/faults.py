@@ -20,6 +20,10 @@ exercised every seam):
     reload.parse        /reload, before parsing the new model
     frontend.spawn      each front-end worker (re)spawn attempt
     ingest.shard_write  out-of-core ingest, before each shard commit
+    refresh.train_spawn each refresh-agent retrain subprocess spawn
+    refresh.eval        entering a shadow-eval pass (refresh agent)
+    deploy.push         each push of a challenger into the fleet
+    deploy.promote      each default-swap promotion attempt
 
 Schedule spec (config key `faults=...` or env LGBM_TPU_FAULTS;
 ';'-separated entries):
@@ -56,6 +60,8 @@ KNOWN_FAULTPOINTS: Tuple[str, ...] = (
     "dist.connect", "dist.send", "dist.recv",
     "serve.dispatch", "reload.parse", "frontend.spawn",
     "ingest.shard_write",
+    "refresh.train_spawn", "refresh.eval", "deploy.push",
+    "deploy.promote",
 )
 
 ENV_VAR = "LGBM_TPU_FAULTS"

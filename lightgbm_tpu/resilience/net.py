@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, List, Tuple
 
 from ..utils import log
+from .backoff import Backoff
 from .faults import FaultInjected, faultpoint
 
 
@@ -40,13 +41,14 @@ def connect_with_retry(connect: Callable[[], Any], what: str,
                        deadline_s: float = 120.0,
                        base_delay_s: float = 0.5,
                        max_delay_s: float = 8.0) -> Any:
-    """Run `connect()` with exponential backoff until it succeeds or
-    the overall deadline expires (NetworkError, chaining the last
-    attempt's error).  Every attempt passes the `dist.connect`
-    faultpoint first, so chaos schedules can fail exact attempts."""
+    """Run `connect()` with exponential backoff (the shared
+    resilience/backoff.Backoff curve) until it succeeds or the overall
+    deadline expires (NetworkError, chaining the last attempt's
+    error).  Every attempt passes the `dist.connect` faultpoint first,
+    so chaos schedules can fail exact attempts."""
+    curve = Backoff(base_s=base_delay_s, cap_s=max_delay_s)
     t0 = time.monotonic()
     attempt = 0
-    delay = base_delay_s
     while True:
         attempt += 1
         try:
@@ -56,6 +58,7 @@ def connect_with_retry(connect: Callable[[], Any], what: str,
             last: BaseException = ex
         except Exception as ex:
             last = ex
+        delay = curve.delay(attempt)
         elapsed = time.monotonic() - t0
         if elapsed + delay > deadline_s:
             raise NetworkError(
@@ -65,7 +68,6 @@ def connect_with_retry(connect: Callable[[], Any], what: str,
         log.warning("%s attempt %d failed (%s); retrying in %.1fs"
                     % (what, attempt, last, delay))
         time.sleep(delay)
-        delay = min(delay * 2.0, max_delay_s)
 
 
 def call_with_deadline(fn: Callable[[], Any], timeout_s: float,

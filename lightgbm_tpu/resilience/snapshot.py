@@ -100,6 +100,28 @@ def snapshot_name(iteration: int, rank: int = 0) -> str:
     return "snapshot_r%d_iter%08d.lgts" % (rank, iteration)
 
 
+@contract.rank_uniform
+def is_checkpoint_file(path: str) -> bool:
+    """True when `path` holds a trainer checkpoint archive (the
+    save_checkpoint npz/zip format, sha-footered or not) rather than a
+    model TEXT file.  init_model/input_model warm starts route on this
+    probe: a checkpoint takes the bit-exact load_checkpoint path, a
+    text model takes the reference's re-boost-from-scores path (model
+    text starts with its boosting-type line, never zip magic).
+
+    @contract.rank_uniform: the probe answers off the shared
+    input_model artifact every rank points at (the is_manifest_path
+    argument) — ranks disagreeing would mean ranks were handed
+    different base models, which the config fingerprint already
+    forbids for the path and the checkpoint fingerprint for the
+    content."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"PK\x03\x04"
+    except OSError:
+        return False
+
+
 def _probe_snapshot(path: str, expect_fp: Optional[str] = None
                     ) -> Tuple[Optional[str], int]:
     """(rejection reason or None, snapshot iteration) with ONE
@@ -379,6 +401,6 @@ class SnapshotManager:
         return vote_any(flag)
 
 
-__all__ = ["SnapshotManager", "snapshot_name", "validate_snapshot",
-           "resume_fingerprint", "fingerprint_diff", "REQUIRED_KEYS",
-           "FP_KEYS"]
+__all__ = ["SnapshotManager", "snapshot_name", "is_checkpoint_file",
+           "validate_snapshot", "resume_fingerprint",
+           "fingerprint_diff", "REQUIRED_KEYS", "FP_KEYS"]

@@ -106,17 +106,20 @@ class ModelFleet:
             self._registered[path] = True
 
     def reload(self, path: str, make_default: bool = False,
-               loader: Any = None) -> ServingForest:
+               loader: Any = None, register: bool = False) -> ServingForest:
         """Parse + warm a FRESH forest for `path` off to the side, then
         swap it into the pool atomically (in-flight batches keep keying
         on the old instance).  make_default also repoints the default
-        model — the single-model /reload semantics, and the ONE way a
-        new path enters the registry over HTTP (an operator-initiated
-        default swap).  The in-place form (make_default=False) only
-        refreshes an ALREADY-registered entry: a typo'd /reload?model=
-        is a 400, not a silent allow-list expansion.  Any failure
-        propagates BEFORE the swap, so the old forest keeps serving."""
-        if not make_default:
+        model — the single-model /reload semantics.  register=True is
+        the deploy agent's challenger PUSH: the path enters the
+        registry and warms WITHOUT becoming default (shadow traffic via
+        /predict?model= first; promotion is a later make_default call).
+        Both are operator-initiated BODY forms over HTTP — the in-place
+        query form (make_default=False, register=False) only refreshes
+        an ALREADY-registered entry: a typo'd /reload?model= is a 400,
+        not a silent allow-list expansion.  Any failure propagates
+        BEFORE the swap, so the old forest keeps serving."""
+        if not make_default and not register:
             with self._lock:
                 if path not in self._registered:
                     raise UnknownModelError(path)

@@ -44,11 +44,17 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..config import Config
+from ..resilience.backoff import Backoff
 from ..resilience.faults import faultpoint
 from ..utils import log
 
 RESPAWN_BACKOFF_S = 0.5
 RESPAWN_BACKOFF_MAX_S = 30.0
+#: one curve for both crash-loop flavors (pre-ready strikes and
+#: post-ready fast deaths) — the shared resilience/backoff helper, so
+#: the respawn throttle cannot drift from the connect/deploy retries
+_RESPAWN_CURVE = Backoff(base_s=RESPAWN_BACKOFF_S,
+                         cap_s=RESPAWN_BACKOFF_MAX_S)
 #: consecutive never-became-ready deaths per slot before the supervisor
 #: gives up — but ONLY while NO worker has ever signaled readiness (a
 #: broken model/config at startup should exit with the diagnostic, like
@@ -295,9 +301,7 @@ class Frontend:
             if throttle:
                 # one backoff curve for both crash-loop flavors
                 # (pre-ready strikes and post-ready fast deaths)
-                time.sleep(min(
-                    RESPAWN_BACKOFF_S * (2 ** (throttle - 1)),
-                    RESPAWN_BACKOFF_MAX_S))
+                time.sleep(_RESPAWN_CURVE.delay(throttle))
             try:
                 self._spawn(idx)
             except Exception as ex:

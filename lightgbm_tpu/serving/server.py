@@ -261,6 +261,23 @@ class Metrics:
                         % (doc["source"], str(doc["sha"])[:12],
                            int(bool(doc.get("default"))),
                            doc["loaded_at"]))
+                # model age: the staleness signal refresh dashboards
+                # alert on (a stuck deploy agent shows up as the
+                # default model's age climbing past the cadence)
+                out.append("# HELP lgbm_serve_model_age_seconds "
+                           "seconds since each warm fleet model was "
+                           "loaded")
+                out.append("# TYPE lgbm_serve_model_age_seconds gauge")
+                now = time.time()
+                for doc in models:
+                    if not doc.get("warm"):
+                        continue
+                    out.append(
+                        'lgbm_serve_model_age_seconds'
+                        '{model="%s",sha="%s",default="%d"} %.3f'
+                        % (doc["source"], str(doc["sha"])[:12],
+                           int(bool(doc.get("default"))),
+                           max(0.0, now - doc["loaded_at"])))
             if worker is not None:
                 # multi-process front-end: which worker answered this
                 # scrape, and that it is alive — repeated scrapes land
@@ -579,16 +596,19 @@ class ServingState:
         return _split_lines(blob, counts)
 
     # -- hot swap -------------------------------------------------------
-    def reload(self, model_path: str,
-               make_default: bool = True) -> Dict[str, Any]:
+    def reload(self, model_path: str, make_default: bool = True,
+               register_new: bool = False) -> Dict[str, Any]:
         """Parse + warm the new model OFF TO THE SIDE, then swap it
         into the fleet atomically: ANY failure in here (unreadable
         path, parse error, warm-up crash — the reload.parse faultpoint
         simulates them) propagates BEFORE the swap, so the old forest
         keeps serving untouched.  make_default repoints the default
         model at the new path (the single-model /reload semantics);
-        make_default=False is the fleet's per-model in-place reload
-        (/reload?model=<path>), leaving the default alone."""
+        make_default=False with register_new is the deploy agent's
+        challenger push (body {"model":..,"default":false} — registers
+        + warms WITHOUT promotion); plain make_default=False is the
+        fleet's per-model in-place reload (/reload?model=<path>),
+        leaving the default alone."""
         with self._swap_lock:
             old = self.fleet.default()
             was_degraded = self.degraded
@@ -606,7 +626,8 @@ class ServingState:
 
             fresh = self.fleet.reload(model_path,
                                       make_default=make_default,
-                                      loader=loader)
+                                      loader=loader,
+                                      register=register_new)
             # in-flight batches keep keying on the old instance.  The
             # degraded flag is DERIVED from the pool, so swapping a
             # degraded instance out is what closes its breaker; prune
@@ -730,8 +751,13 @@ def _make_handler(state: ServingState) -> type:
                            "model": state.forest.info(),
                            "models": state.fleet.info()}
                     if state.worker_index is not None:
+                        # count included so a deploy agent knows how
+                        # many per-connection-routed workers it must
+                        # see confirm a push before calling it done
                         doc["worker"] = {"index": state.worker_index,
-                                         "pid": os.getpid()}
+                                         "pid": os.getpid(),
+                                         "count":
+                                             state.cfg.serve_workers}
                     self._respond(200, json.dumps(doc).encode(),
                                   "application/json")
                 elif path == "/metrics":
@@ -871,10 +897,16 @@ def _make_handler(state: ServingState) -> type:
             # reload: an ALREADY-REGISTERED entry re-parses + re-warms,
             # the default model stays put (unregistered paths 400).  A
             # body {"model": path} without the query keeps the
-            # single-model semantics: swap the default (the one way a
-            # new path enters the registry over HTTP).
+            # single-model semantics: swap the default (the operator-
+            # initiated way a new path enters the registry over HTTP).
+            # Body {"model": path, "default": false} is the deploy
+            # agent's challenger PUSH: register + warm WITHOUT
+            # promotion, so shadow traffic can hit /predict?model=
+            # while the champion stays default.
             in_place = q.get("model", [None])[0]
             path = in_place or state.cfg.input_model
+            make_default = not in_place
+            register_new = False
             if body.strip():
                 try:
                     doc = json.loads(body.decode("utf-8"))
@@ -886,11 +918,15 @@ def _make_handler(state: ServingState) -> type:
                             "give the model either as ?model= or in "
                             "the body, not both")
                     path = str(doc["model"])
+                    if "default" in doc and not doc["default"]:
+                        make_default = False
+                        register_new = True
             if not path:
                 raise BadRequest("no model path: configure input_model "
                                  'or POST {"model": "<path>"}')
             try:
-                info = state.reload(path, make_default=not in_place)
+                info = state.reload(path, make_default=make_default,
+                                    register_new=register_new)
             except Exception as ex:
                 # ANY reload failure leaves the old forest serving
                 # (the swap happens last inside state.reload); report

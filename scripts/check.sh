@@ -27,6 +27,11 @@
 #   5. scripts/ingest_smoke.sh (when jax imports): out-of-core ingest
 #      SIGKILL + resume byte identity, shard-fed vs text training and
 #      predict byte parity
+#   6. scripts/refresh_smoke.sh (when jax imports): continuous
+#      train->deploy — ingest -> warm-start retrain -> shadow-eval ->
+#      promote with byte-compares vs task=predict, plus the SIGKILL-at-
+#      deploy.push chaos leg (champion keeps serving byte-identically,
+#      the rerun converges and promotes)
 #
 # Exit codes:
 #   0  everything that ran is clean
@@ -78,8 +83,12 @@ if python -c "import jax" 2>/dev/null; then
     bash scripts/ingest_smoke.sh
     g=$?
     [ "$g" -ne 0 ] && rc=1
+    echo "== refresh smoke (warm-start retrain + shadow-eval promote + kill-at-push chaos) =="
+    bash scripts/refresh_smoke.sh
+    r=$?
+    [ "$r" -ne 0 ] && rc=1
 else
-    echo "== jax not importable — chaos_smoke + serve_smoke + ingest_smoke SKIPPED (jax-free lane) =="
+    echo "== jax not importable — chaos_smoke + serve_smoke + ingest_smoke + refresh_smoke SKIPPED (jax-free lane) =="
 fi
 
 if [ "$rc" -eq 0 ]; then

@@ -51,7 +51,8 @@ import time as _time  # noqa: E402
 
 import pytest  # noqa: E402
 
-_GATED_THREAD_NAMES = ("lgbm-window-prefetch", "serve-batcher")
+_GATED_THREAD_NAMES = ("lgbm-window-prefetch", "serve-batcher",
+                       "lgbm-refresh-")
 
 
 @pytest.fixture

@@ -461,7 +461,7 @@ def test_ingest_sigkill_resume_byte_identical(tmp_path):
 # every faultpoint is reachable through its REAL seam (tier-1)
 # ---------------------------------------------------------------------------
 
-def test_every_faultpoint_reachable(tmp_path):
+def test_every_faultpoint_reachable(tmp_path, monkeypatch):
     """Drive each registered faultpoint through the production code
     path that hosts it and prove the seam was crossed (hits > 0) —
     the closed registry plus this test means a chaos schedule can
@@ -531,6 +531,44 @@ def test_every_faultpoint_reachable(tmp_path):
     run_ingest([ing_src], str(tmp_path / "ingest_shards"),
                Config.from_params({"ingest_workers": "1",
                                    "ingest_shard_rows": "128"}))
+
+    # refresh.train_spawn / refresh.eval / deploy.push /
+    # deploy.promote: ONE real refresh-agent cycle against a native
+    # serving fleet — the retrain subprocess is a trivial interpreter
+    # (the spawn seam still crosses for real), and a winning
+    # challenger drives push, shadow eval AND promotion
+    from test_refresh import CHALLENGER_MODEL, WIN_EVAL
+    from test_serving import serve as serve_ctx
+    from lightgbm_tpu.ingest.manifest import snapshot_sources
+    from lightgbm_tpu.refresh.agent import RefreshAgent
+
+    champ = str(tmp_path / "refresh_champ.txt")
+    with open(champ, "w") as f:
+        f.write(BINARY_MODEL)
+    evf = str(tmp_path / "refresh_eval.tsv")
+    with open(evf, "w") as f:
+        f.write(WIN_EVAL)
+    dropd = tmp_path / "refresh_drop"
+    dropd.mkdir()
+    with open(str(dropd / "d.tsv"), "w") as f:
+        f.write(WIN_EVAL)
+
+    def _argv(self, data_path, out_model):
+        return [sys.executable, "-c",
+                "import pathlib, sys; "
+                "pathlib.Path(sys.argv[1]).write_text(sys.argv[2])",
+                out_model, CHALLENGER_MODEL]
+
+    monkeypatch.setattr(RefreshAgent, "_train_argv", _argv)
+    with serve_ctx(champ, serve_backend="native") as srv:
+        agent = RefreshAgent(Config.from_params({
+            "task": "refresh", "objective": "binary",
+            "refresh_drop_dir": str(dropd),
+            "refresh_serve_url": srv.url,
+            "refresh_eval_data": evf, "input_model": champ,
+            "refresh_deadline_s": "30"}))
+        assert agent.run_cycle(snapshot_sources(str(dropd))) \
+            == "promoted"
 
     missing = [n for n in faults.KNOWN_FAULTPOINTS
                if faults.hits(n) == 0]

@@ -129,6 +129,37 @@ class TestSequenceDivergence:
         """))
         assert by_rule(fs, "GC009") == []
 
+    def test_isinstance_on_module_class_accepted(self):
+        """isinstance's TYPE argument is program text (identical on
+        every rank): a module-level class name there must not poison
+        the condition — only the tested VALUE decides uniformity."""
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            class Box:
+                pass
+
+            def step(payload, data):
+                if isinstance(payload, Box):
+                    data = process_allgather(data)
+                return data
+        """))
+        assert by_rule(fs, "GC009") == []
+
+    def test_isinstance_on_rank_local_value_still_flagged(self):
+        fs = run_graftcheck_sources(synth(a="""
+            from .parallel.dist import process_allgather
+
+            class Box:
+                pass
+
+            def step(rank, data):
+                if isinstance(rank, Box):
+                    data = process_allgather(data)
+                return data
+        """))
+        assert len(by_rule(fs, "GC009")) == 1
+
     def test_unannotated_helper_condition_flagged(self):
         """Same shape as above WITHOUT the annotation: the helper's
         result is rank-local until someone claims otherwise."""

@@ -1,0 +1,621 @@
+"""Continuous train->deploy: init_model warm starts, the unified
+backoff curve's consumers, the jax-free refresh agent (retrain ->
+push -> shadow-eval -> promote), and its chaos posture.
+
+The load-bearing contracts:
+
+  * init_model=<checkpoint> warm-start continuation is BIT-parity with
+    checkpoint-resume continuation for the same split point — and with
+    a from-scratch run of the same total rounds — across
+    {binary, multiclass, DART, bagged} (acceptance criterion).
+  * init_model=<model text> is the reference's re-boost-from-scores
+    continued training; the api path matches the cli path byte-for-byte
+    on the same data.
+  * A refresh cycle promotes ONLY on a shadow-eval metric win; a losing
+    or erroring challenger is never made default, and the fleet keeps
+    answering byte-identically to task=predict with the champion.
+  * Every new faultpoint (refresh.train_spawn / refresh.eval /
+    deploy.push / deploy.promote) fails the cycle cleanly: champion
+    intact, next cycle converges and promotes.
+
+Fast tests ride a fake retrain subprocess (the agent's _train_argv is
+injectable) against a native-backend serving fleet; the slow leg and
+scripts/refresh_smoke.sh run the real task=train warm-start chain.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.refresh.agent import (RefreshAgent, parse_label_column,
+                                        parse_score_rows, shadow_loss)
+from lightgbm_tpu.resilience import faults
+
+from test_predict_fast import BINARY_MODEL
+from test_serving import _write, cli_predict, get, post, serve
+
+# every test in this module must leave no worker threads (incl. the
+# agent's lgbm-refresh-* pools, gated in conftest)
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: BINARY_MODEL with scaled-up leaf values: on rows with feature0=1
+#: (routed to leaf0 of tree 0) it predicts the SAME sign but more
+#: confidently — so it WINS shadow eval when those rows are labeled 1
+#: and LOSES when they are labeled 0
+CHALLENGER_MODEL = BINARY_MODEL.replace("leaf_value=0.2 -0.13 0.34",
+                                        "leaf_value=0.9 -0.7 0.55")
+
+#: eval rows routed to leaf0 (feature0=1): labels decide who wins
+WIN_EVAL = "".join("1\t1\t0\t0\t0\n" for _ in range(8))
+LOSE_EVAL = "".join("0\t1\t0\t0\t0\n" for _ in range(8))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# init_model=<checkpoint>: bit-parity with checkpoint-resume continuation
+# ---------------------------------------------------------------------------
+
+WARM_CONFIGS = {
+    "binary": {"objective": "binary"},
+    "multiclass": {"objective": "multiclass", "num_class": 3},
+    "dart": {"objective": "binary", "boosting_type": "dart",
+             "drop_rate": 0.3},
+    # freq=2 with the split at 5: the warm start lands mid-bagging-epoch
+    "bagged": {"objective": "binary", "bagging_fraction": 0.5,
+               "bagging_freq": 2},
+}
+
+
+def _warm_data(objective):
+    rng = np.random.RandomState(3)
+    x = rng.randn(300, 6)
+    s = x[:, 0] + 0.5 * x[:, 1] * x[:, 2]
+    if objective == "multiclass":
+        y = np.digitize(s, np.quantile(s, [1 / 3, 2 / 3]))
+    else:
+        y = (s > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.mark.parametrize("name", sorted(WARM_CONFIGS))
+def test_init_model_checkpoint_warm_start_bit_parity(tmp_path, name):
+    """train(init_model=<ckpt @5>) == checkpoint-resume continuation
+    == from-scratch 10-round run, byte for byte."""
+    extra = WARM_CONFIGS[name]
+    params = {"num_leaves": 7, "min_data_in_leaf": 5, "metric": "",
+              **extra}
+    x, y = _warm_data(extra["objective"])
+
+    def ds():
+        return lgb.Dataset(x, label=y,
+                           params={k: str(v) for k, v in params.items()})
+
+    oracle = lgb.train(params, ds(), num_boost_round=10,
+                       verbose_eval=False)
+    half = lgb.train(params, ds(), num_boost_round=5,
+                     verbose_eval=False)
+    ckpt = str(tmp_path / "warm.lgts")
+    half.save_checkpoint(ckpt)
+
+    warm = lgb.train(params, ds(), num_boost_round=10,
+                     init_model=ckpt, verbose_eval=False)
+    resumed = lgb.train({**params, "resume": ckpt}, ds(),
+                        num_boost_round=10, verbose_eval=False)
+    assert warm._gbdt.iter == 10
+    assert warm.model_to_string() == resumed.model_to_string(), \
+        "init_model warm start diverged from checkpoint-resume (%s)" \
+        % name
+    assert warm.model_to_string() == oracle.model_to_string(), \
+        "warm-start continuation diverged from the from-scratch run " \
+        "(%s)" % name
+
+
+def test_init_model_checkpoint_beyond_rounds_refused(tmp_path):
+    from lightgbm_tpu.utils import log
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": ""}
+    x, y = _warm_data("binary")
+    b = lgb.train(params, lgb.Dataset(x, label=y,
+                                      params={k: str(v) for k, v
+                                              in params.items()}),
+                  num_boost_round=6, verbose_eval=False)
+    ckpt = str(tmp_path / "warm.lgts")
+    b.save_checkpoint(ckpt)
+    with pytest.raises(log.LightGBMError, match="beyond"):
+        lgb.train(params, lgb.Dataset(
+            x, label=y, params={k: str(v) for k, v in params.items()}),
+            num_boost_round=4, init_model=ckpt, verbose_eval=False)
+
+
+# ---------------------------------------------------------------------------
+# init_model=<model text>: the reference re-boost path, api == cli
+# ---------------------------------------------------------------------------
+
+def _write_train_file(path, n=400, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 6)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(int)
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write("%d\t" % y[i]
+                    + "\t".join("%.6g" % v for v in x[i]) + "\n")
+    return str(path)
+
+
+TRAIN_ARGS = ["num_leaves=7", "max_bin=63", "min_data_in_leaf=20",
+              "metric=", "verbose=0", "objective=binary"]
+
+
+def test_init_model_text_reboost_api_matches_cli(tmp_path):
+    """Continued training from a model TEXT file: api.train
+    (init_model=) and cli (input_model=) produce byte-identical models
+    — the shared re-boost-from-scores semantics."""
+    data = _write_train_file(tmp_path / "train.tsv")
+    base = str(tmp_path / "base.txt")
+    Application(["task=train", "data=" + data, "output_model=" + base,
+                 "num_iterations=3", *TRAIN_ARGS]).run()
+    cli_cont = str(tmp_path / "cli_cont.txt")
+    Application(["task=train", "data=" + data, "input_model=" + base,
+                 "output_model=" + cli_cont, "num_iterations=2",
+                 *TRAIN_ARGS]).run()
+
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+              "min_data_in_leaf": 20, "metric": "", "verbose": 0}
+    booster = lgb.train(params, lgb.Dataset(
+        data, params={k: str(v) for k, v in params.items()}),
+        num_boost_round=2, init_model=base, verbose_eval=False)
+    api_cont = str(tmp_path / "api_cont.txt")
+    booster.save_model(api_cont)
+    with open(cli_cont, "rb") as fa, open(api_cont, "rb") as fb:
+        assert fa.read() == fb.read(), \
+            "api init_model= re-boost diverged from cli input_model="
+    # the continued model holds old + new trees
+    assert len(booster._gbdt.models) == 5
+
+
+def test_init_model_accepts_model_string_and_booster(tmp_path):
+    """The documented third/fourth input forms: a model-TEXT string
+    (model_to_string output — multi-line, NOT a path) loads as text
+    rather than crashing in open(), identical to the same text given
+    as a file path; a live Booster works too (its exact f64 trees can
+    differ from the %g text round-trip in low bits, so only the
+    structure is asserted there)."""
+    x, y = _warm_data("binary")
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": ""}
+
+    def ds():
+        return lgb.Dataset(x, label=y, free_raw_data=False,
+                           params={k: str(v) for k, v in params.items()})
+
+    old = lgb.train(params, ds(), num_boost_round=3,
+                    verbose_eval=False)
+    text = old.model_to_string()
+    path = _write(tmp_path / "old.txt", text)
+    from_str = lgb.train(params, ds(), num_boost_round=2,
+                         init_model=text, verbose_eval=False)
+    from_path = lgb.train(params, ds(), num_boost_round=2,
+                          init_model=path, verbose_eval=False)
+    assert from_str.model_to_string() == from_path.model_to_string()
+    from_booster = lgb.train(params, ds(), num_boost_round=2,
+                             init_model=old, verbose_eval=False)
+    assert len(from_str._gbdt.models) == 5
+    assert len(from_booster._gbdt.models) == 5
+
+
+def test_refresh_min_gain_rejects_negative():
+    """A negative tolerance would promote a strictly-WORSE challenger
+    — the config surface refuses it up front."""
+    from lightgbm_tpu.utils import log
+    with pytest.raises(log.LightGBMError, match="refresh_min_gain"):
+        Config.from_params({"refresh_min_gain": "-0.1"})
+
+
+def test_init_model_text_needs_raw_features():
+    from lightgbm_tpu.utils import log
+    x, y = _warm_data("binary")
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "metric": ""}
+    old = lgb.train(params, lgb.Dataset(
+        x, label=y, params={k: str(v) for k, v in params.items()}),
+        num_boost_round=2, verbose_eval=False)
+    frozen = lgb.Dataset(x, label=y,
+                         params={k: str(v) for k, v in params.items()})
+    assert frozen._raw is None          # free_raw_data default
+    with pytest.raises(log.LightGBMError, match="free_raw_data"):
+        lgb.train(params, frozen, num_boost_round=2, init_model=old,
+                  verbose_eval=False)
+
+
+# ---------------------------------------------------------------------------
+# serving: push-without-promote + refresh observability
+# ---------------------------------------------------------------------------
+
+def test_reload_push_without_promote(tmp_path):
+    """Body {"model":.., "default": false} registers + warms a NEW
+    path without repointing the default — the deploy agent's challenger
+    push (the in-place ?model= form keeps its registered-only rule,
+    pinned in test_serving_fleet)."""
+    champ = _write(tmp_path / "champ.txt", BINARY_MODEL)
+    chall = _write(tmp_path / "chall.txt", CHALLENGER_MODEL)
+    with serve(champ, serve_backend="native") as srv:
+        url = srv.url
+        _, h0 = get(url, "/healthz")
+        champ_sha = json.loads(h0)["model"]["sha"]
+        code, _ = post(url, "/reload",
+                       json.dumps({"model": chall,
+                                   "default": False}).encode(),
+                       ctype="application/json")
+        assert code == 200
+        _, h1 = get(url, "/healthz")
+        doc = json.loads(h1)
+        assert doc["model"]["sha"] == champ_sha, \
+            "push must not repoint the default"
+        warm = {m["source"]: m for m in doc["models"] if m.get("warm")}
+        assert chall in warm and not warm[chall]["default"]
+        # the pushed model serves shadow traffic via ?model=
+        body = WIN_EVAL.encode()
+        _, direct = post(url, "/predict?mode=raw&model=" + chall, body)
+        assert direct.strip()
+        # /metrics: model age per warm fleet model (refresh staleness)
+        _, mtr = get(url, "/metrics")
+        ages = [ln for ln in mtr.decode().splitlines()
+                if ln.startswith("lgbm_serve_model_age_seconds{")]
+        assert len(ages) == 2
+        assert any('default="1"' in ln for ln in ages)
+        assert any('default="0"' in ln for ln in ages)
+
+
+# ---------------------------------------------------------------------------
+# the refresh agent (fake retrain subprocess; native serving fleet)
+# ---------------------------------------------------------------------------
+
+def _fake_trainer(monkeypatch, challenger_text=CHALLENGER_MODEL):
+    """Replace the retrain subprocess with a trivial interpreter that
+    writes `challenger_text` — the spawn/retry/faultpoint machinery
+    stays real, only the training work is stubbed."""
+    def argv(self, data_path, out_model):
+        assert os.path.isfile(data_path)   # cycle data staged for real
+        return [sys.executable, "-c",
+                "import pathlib, sys; "
+                "pathlib.Path(sys.argv[1]).write_text(sys.argv[2])",
+                out_model, challenger_text]
+    monkeypatch.setattr(RefreshAgent, "_train_argv", argv)
+
+
+def _agent(tmp_path, url, eval_text=WIN_EVAL, **extra):
+    drop = tmp_path / "drop"
+    drop.mkdir(exist_ok=True)
+    champ = _write(tmp_path / "champion.txt", BINARY_MODEL)
+    ev = _write(tmp_path / "eval.tsv", eval_text)
+    params = {"task": "refresh", "objective": "binary",
+              "refresh_drop_dir": str(drop),
+              "refresh_serve_url": url,
+              "refresh_eval_data": ev,
+              "input_model": champ,
+              "refresh_deadline_s": "30",
+              "refresh_period_s": "0",
+              "refresh_poll_s": "0.05",
+              "refresh_cooldown_s": "60",
+              **{k: str(v) for k, v in extra.items()}}
+    return RefreshAgent(Config.from_params(params)), str(drop), champ
+
+
+def _drop_file(drop_dir, name="drop_0.tsv"):
+    return _write(os.path.join(drop_dir, name),
+                  "".join("1\t1\t0\t0\t0\n" for _ in range(16)))
+
+
+def _sources(drop_dir):
+    from lightgbm_tpu.ingest.manifest import snapshot_sources
+    return snapshot_sources(drop_dir)
+
+
+def _default_sha(url):
+    _, body = get(url, "/healthz")
+    return json.loads(body)["model"]["sha"]
+
+
+def _served_bytes(url, data_path):
+    with open(data_path, "rb") as f:
+        _, body = post(url, "/predict", f.read())
+    return body
+
+
+def test_agent_cycle_promotes_winning_challenger(tmp_path, monkeypatch):
+    _fake_trainer(monkeypatch)
+    champ0 = _write(tmp_path / "c0.txt", BINARY_MODEL)
+    with serve(champ0, serve_backend="native") as srv:
+        agent, drop, champ = _agent(tmp_path, srv.url)
+        agent.wait_serving()
+        _drop_file(drop)
+        outcome = agent.run_cycle(_sources(drop))
+        assert outcome == "promoted"
+        # the fleet default IS the challenger now, byte-for-byte
+        chall_path = os.path.join(agent.work_dir, "challenger_0000.txt")
+        import hashlib
+        with open(chall_path, "rb") as f:
+            chall_sha = hashlib.sha256(f.read()).hexdigest()
+        assert _default_sha(srv.url) == chall_sha
+        assert agent.champion == chall_path
+        data = _write(tmp_path / "probe.tsv", WIN_EVAL)
+        want = cli_predict(tmp_path, chall_path, data, "normal")
+        assert _served_bytes(srv.url, data) == want
+        # consumed ledger: the drop is not re-trained next poll
+        assert list(agent.consumed) == [os.path.join(drop,
+                                                     "drop_0.tsv")]
+        # agent metrics: outcome counter + shadow delta
+        mtr = agent.render_metrics().decode()
+        assert 'lgbm_refresh_cycles_total{outcome="promoted"} 1' in mtr
+        assert "lgbm_refresh_shadow_delta" in mtr
+        # durable state survives an agent restart (same champion)
+        agent2 = RefreshAgent(agent.cfg)
+        assert agent2.champion == chall_path
+        assert agent2.outcomes["promoted"] == 1
+
+
+def test_agent_cycle_rejects_losing_challenger(tmp_path, monkeypatch):
+    """A challenger that scores WORSE on the held-out rows is demoted:
+    never made default, counted as rejected, champion bytes keep
+    serving."""
+    _fake_trainer(monkeypatch)
+    champ0 = _write(tmp_path / "c0.txt", BINARY_MODEL)
+    with serve(champ0, serve_backend="native") as srv:
+        agent, drop, champ = _agent(tmp_path, srv.url,
+                                    eval_text=LOSE_EVAL)
+        agent.wait_serving()
+        _drop_file(drop)
+        sha_before = _default_sha(srv.url)
+        outcome = agent.run_cycle(_sources(drop))
+        assert outcome == "rejected"
+        assert _default_sha(srv.url) == sha_before
+        assert agent.champion == champ
+        data = _write(tmp_path / "probe.tsv", LOSE_EVAL)
+        want = cli_predict(tmp_path, champ, data, "normal")
+        assert _served_bytes(srv.url, data) == want
+        mtr = agent.render_metrics().decode()
+        assert 'lgbm_refresh_cycles_total{outcome="rejected"} 1' in mtr
+        # the loser stays shadow-only: warm in the fleet, non-default
+        _, h = get(srv.url, "/healthz")
+        warm = {m["source"]: m for m in json.loads(h)["models"]
+                if m.get("warm")}
+        chall_path = os.path.join(agent.work_dir, "challenger_0000.txt")
+        assert chall_path in warm
+        assert not warm[chall_path]["default"]
+
+
+@pytest.mark.parametrize("fp", ["refresh.train_spawn", "refresh.eval",
+                                "deploy.push", "deploy.promote"])
+def test_agent_fault_fails_cycle_champion_intact_then_converges(
+        tmp_path, monkeypatch, fp):
+    """Chaos acceptance (raise flavor): a fault at ANY refresh/deploy
+    seam fails that cycle, the fleet keeps answering byte-identically
+    to task=predict with the champion, and the NEXT cycle completes
+    and promotes."""
+    _fake_trainer(monkeypatch)
+    champ0 = _write(tmp_path / "c0.txt", BINARY_MODEL)
+    with serve(champ0, serve_backend="native") as srv:
+        agent, drop, champ = _agent(tmp_path, srv.url)
+        agent.wait_serving()
+        _drop_file(drop)
+        data = _write(tmp_path / "probe.tsv", WIN_EVAL)
+        want_champ = cli_predict(tmp_path, champ, data, "normal")
+        sha_before = _default_sha(srv.url)
+
+        faults.configure("%s@1=raise" % fp)
+        outcome = agent.run_cycle(_sources(drop))
+        assert outcome == "failed"
+        assert faults.fired(fp) == 1
+        # the champion is untouched AND still the default
+        assert _default_sha(srv.url) == sha_before
+        assert _served_bytes(srv.url, data) == want_champ
+        # the drop stays unconsumed: the next cycle retries it
+        assert not agent.consumed
+
+        faults.reset()
+        outcome = agent.run_cycle(_sources(drop))
+        assert outcome == "promoted", \
+            "the cycle after a %s fault must converge" % fp
+        assert _default_sha(srv.url) != sha_before
+
+
+def test_agent_breaker_opens_after_consecutive_failures(
+        tmp_path, monkeypatch):
+    _fake_trainer(monkeypatch)
+    champ0 = _write(tmp_path / "c0.txt", BINARY_MODEL)
+    with serve(champ0, serve_backend="native") as srv:
+        agent, drop, champ = _agent(tmp_path, srv.url,
+                                    refresh_breaker_threshold=2)
+        agent.wait_serving()
+        _drop_file(drop)
+        faults.configure("refresh.train_spawn@1+=raise")
+        assert agent.run_cycle(_sources(drop)) == "failed"
+        assert not agent.breaker_open()
+        assert agent.run_cycle(_sources(drop)) == "failed"
+        assert agent.breaker_open(), \
+            "2 consecutive failures must open the breaker"
+        mtr = agent.render_metrics().decode()
+        assert "lgbm_refresh_breaker_open 1" in mtr
+        assert "lgbm_refresh_consecutive_failures 2" in mtr
+        assert 'lgbm_refresh_cycles_total{outcome="failed"} 2' in mtr
+        # champion keeps serving throughout
+        assert _default_sha(srv.url) == _sha_of(champ)
+
+
+def _sha_of(path):
+    import hashlib
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def test_agent_run_forever_watch_promote_drain(tmp_path, monkeypatch):
+    """The full supervised loop: watcher picks up a STABLE drop,
+    a cycle runs and promotes, refresh_max_cycles exits the loop, and
+    the drain leaves no agent thread behind (the module-level
+    no_leaked_threads gate checks the lgbm-refresh-* pools)."""
+    _fake_trainer(monkeypatch)
+    champ0 = _write(tmp_path / "c0.txt", BINARY_MODEL)
+    with serve(champ0, serve_backend="native") as srv:
+        agent, drop, champ = _agent(tmp_path, srv.url,
+                                    refresh_max_cycles=1)
+        agent.start()
+        try:
+            # agent status endpoint answers over HTTP
+            assert agent.status_url is not None
+            with urllib.request.urlopen(agent.status_url
+                                        + "/metrics", timeout=10) as r:
+                assert b"lgbm_refresh_cycles_total" in r.read()
+            _drop_file(drop)
+            t = threading.Thread(target=agent.run_forever)
+            t.start()
+            t.join(60)
+            assert not t.is_alive(), "run_forever did not exit at " \
+                                     "refresh_max_cycles"
+        finally:
+            agent.shutdown()
+        assert agent.outcomes["promoted"] == 1
+        assert _default_sha(srv.url) == _sha_of(
+            os.path.join(agent.work_dir, "challenger_0000.txt"))
+
+
+# ---------------------------------------------------------------------------
+# shadow-eval scoring units
+# ---------------------------------------------------------------------------
+
+def test_parse_label_column_formats():
+    assert parse_label_column(b"1\t0.5\t2\n0\t1.5\t3\n", 0).tolist() \
+        == [1.0, 0.0]
+    assert parse_label_column(b"1,0.5,2\n0,1.5,3\n", 0).tolist() \
+        == [1.0, 0.0]
+    assert parse_label_column(b"1 0:0.5 2:2\n0 1:1.5\n", 0).tolist() \
+        == [1.0, 0.0]
+
+
+def test_shadow_loss_prefers_better_model():
+    y = np.array([1.0, 1.0, 0.0, 0.0])
+    good = np.array([[2.0], [2.0], [-2.0], [-2.0]])
+    bad = np.array([[0.1], [0.1], [-0.1], [-0.1]])
+    assert shadow_loss(good, y, "binary") < shadow_loss(bad, y, "binary")
+    # multiclass softmax logloss
+    s_good = np.array([[3.0, 0.0, 0.0], [0.0, 3.0, 0.0]])
+    s_bad = np.array([[0.1, 0.0, 0.0], [0.0, 0.1, 0.0]])
+    ym = np.array([0.0, 1.0])
+    assert shadow_loss(s_good, ym, "multiclass") \
+        < shadow_loss(s_bad, ym, "multiclass")
+    # regression = L2 on raw scores
+    yr = np.array([1.0, 2.0])
+    assert shadow_loss(np.array([[1.0], [2.0]]), yr, "regression") \
+        < shadow_loss(np.array([[0.0], [0.0]]), yr, "regression")
+    assert parse_score_rows(b"0.25\n-1.5\n").tolist() \
+        == [[0.25], [-1.5]]
+
+
+def test_shadow_loss_row_mismatch_raises():
+    from lightgbm_tpu.refresh.agent import CycleError
+    with pytest.raises(CycleError, match="rows"):
+        shadow_loss(np.zeros((2, 1)), np.zeros(3), "binary")
+
+
+# ---------------------------------------------------------------------------
+# the real warm-start chain, end to end (slow; the smoke's cousin)
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, faults_spec=None, check=True):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "LGBM_TPU_FAULTS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if faults_spec:
+        env["LGBM_TPU_FAULTS"] = faults_spec
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu"] + args, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600)
+    out = proc.stdout.decode()
+    if check:
+        assert proc.returncode == 0, out
+    return proc.returncode, out
+
+
+@pytest.mark.slow
+def test_refresh_end_to_end_real_training_and_kill(tmp_path):
+    """The production chain with a REAL task=train warm-start retrain:
+    champion trained on a slice, more data dropped, the agent
+    retrains/evals/promotes.  Then the kill flavor of the chaos
+    acceptance: SIGKILL the agent at deploy.push@1 — the fleet still
+    answers byte-identically to the champion — and the rerun
+    converges."""
+    rng = np.random.RandomState(11)
+    n = 900
+    x = rng.randn(n, 6)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(int)
+
+    def rows(a, b):
+        return "".join("%d\t" % y[i]
+                       + "\t".join("%.6g" % v for v in x[i]) + "\n"
+                       for i in range(a, b))
+
+    base_data = _write(tmp_path / "base.tsv", rows(0, 200))
+    ev = _write(tmp_path / "eval.tsv", rows(700, 900))
+    champ = str(tmp_path / "champion.txt")
+    targs = ["num_leaves=7", "max_bin=63", "min_data_in_leaf=20",
+             "metric=", "objective=binary", "verbose=0"]
+    _run_cli(["task=train", "data=" + base_data,
+              "output_model=" + champ, "num_iterations=5", *targs])
+
+    drop = tmp_path / "drop"
+    drop.mkdir()
+    _write(drop / "batch1.tsv", rows(200, 700))
+    with serve(champ, serve_backend="native") as srv:
+        agent_args = ["task=refresh", "refresh_drop_dir=" + str(drop),
+                      "refresh_serve_url=" + srv.url,
+                      "refresh_eval_data=" + ev,
+                      "input_model=" + champ,
+                      "refresh_max_cycles=1", "refresh_period_s=0",
+                      "refresh_poll_s=0.1", "refresh_deadline_s=240",
+                      "refresh_rounds=10", "refresh_status_port=-1",
+                      *targs]
+        champ_bytes = cli_predict(tmp_path, champ, ev, "normal")
+        sha0 = _default_sha(srv.url)
+        assert _served_bytes(srv.url, ev) == champ_bytes
+
+        # kill flavor FIRST: the agent dies the instant it would push
+        rc, out = _run_cli(agent_args,
+                           faults_spec="deploy.push@1=kill",
+                           check=False)
+        assert rc in (-9, 137), out
+        assert _default_sha(srv.url) == sha0
+        assert _served_bytes(srv.url, ev) == champ_bytes, \
+            "a killed refresh must leave the champion serving " \
+            "byte-identically"
+
+        # rerun converges: retrains the SAME drop, evals, promotes
+        # (verbose=0 silences the agent's own log line — promotion is
+        # asserted on the fleet's observable state below)
+        _run_cli(agent_args)
+        sha1 = _default_sha(srv.url)
+        assert sha1 != sha0
+        chall = str(drop / ".refresh" / "challenger_0000.txt")
+        assert _sha_of(chall) == sha1
+        assert _served_bytes(srv.url, ev) \
+            == cli_predict(tmp_path, chall, ev, "normal")
+        # the promoted challenger genuinely holds champion + new trees
+        with open(chall) as f:
+            assert f.read().count("Tree=") == 15

@@ -657,3 +657,126 @@ class TestCorruptCacheFallback:
         assert "Failed to load binary cache" not in capsys.readouterr().out
         np.testing.assert_array_equal(np.asarray(got.bins),
                                       np.asarray(want.bins))
+
+
+# ---------------------------------------------------------------------------
+# resilience/backoff.py — the ONE exponential-backoff curve
+# ---------------------------------------------------------------------------
+
+class TestBackoff:
+    def test_deterministic_curve_and_cap(self):
+        from lightgbm_tpu.resilience.backoff import Backoff
+        b = Backoff(base_s=0.5, cap_s=8.0)
+        assert [b.delay(i) for i in range(1, 7)] \
+            == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0]
+        # raw counters below 1 clamp instead of exploding
+        assert b.delay(0) == 0.5
+        assert b.delay(-3) == 0.5
+        # huge attempt numbers stay at the cap (no float overflow)
+        assert b.delay(10_000) == 8.0
+
+    def test_curve_matches_the_frontend_respawn_formula(self):
+        """The respawn throttle's historical formula
+        min(0.5 * 2**(n-1), 30.0) IS the shared curve — the dedup
+        changed no delays."""
+        from lightgbm_tpu.resilience.backoff import Backoff
+        from lightgbm_tpu.serving.frontend import (
+            RESPAWN_BACKOFF_S, RESPAWN_BACKOFF_MAX_S, _RESPAWN_CURVE)
+        for n in range(1, 12):
+            assert _RESPAWN_CURVE.delay(n) == min(
+                RESPAWN_BACKOFF_S * (2 ** (n - 1)),
+                RESPAWN_BACKOFF_MAX_S)
+        assert _RESPAWN_CURVE.base_s == RESPAWN_BACKOFF_S
+        assert _RESPAWN_CURVE.cap_s == RESPAWN_BACKOFF_MAX_S
+
+    def test_seeded_jitter_is_reproducible(self):
+        from lightgbm_tpu.resilience.backoff import Backoff
+        a = Backoff(base_s=1.0, cap_s=16.0, jitter=0.5, seed=7)
+        b = Backoff(base_s=1.0, cap_s=16.0, jitter=0.5, seed=7)
+        da = [a.delay(i) for i in range(1, 8)]
+        db = [b.delay(i) for i in range(1, 8)]
+        assert da == db, "same seed must replay the same delays"
+        plain = Backoff(base_s=1.0, cap_s=16.0)
+        for n, d in enumerate(da, start=1):
+            full = plain.delay(n)
+            assert full * 0.5 <= d <= full, \
+                "jitter=0.5 keeps a deterministic half floor"
+        c = Backoff(base_s=1.0, cap_s=16.0, jitter=0.5, seed=8)
+        assert [c.delay(i) for i in range(1, 8)] != da
+
+    def test_invalid_parameters_rejected(self):
+        from lightgbm_tpu.resilience.backoff import Backoff
+        with pytest.raises(ValueError):
+            Backoff(base_s=0.0)
+        with pytest.raises(ValueError):
+            Backoff(base_s=2.0, cap_s=1.0)
+        with pytest.raises(ValueError):
+            Backoff(factor=0.5)
+        with pytest.raises(ValueError):
+            Backoff(jitter=1.5)
+
+    def test_retry_succeeds_after_failures(self):
+        from lightgbm_tpu.resilience.backoff import retry_with_backoff
+        calls = []
+        sleeps = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_with_backoff(flaky, "probe", deadline_s=60.0,
+                                 base_s=0.25, cap_s=1.0,
+                                 sleep=sleeps.append)
+        assert out == "ok"
+        assert len(calls) == 3
+        assert sleeps == [0.25, 0.5]    # the curve, not wall clock
+
+    def test_retry_deadline_chains_last_error(self):
+        from lightgbm_tpu.resilience.backoff import (RetryDeadline,
+                                                     retry_with_backoff)
+
+        def always():
+            raise ValueError("still broken")
+
+        with pytest.raises(RetryDeadline) as ei:
+            retry_with_backoff(always, "probe", deadline_s=0.0,
+                               base_s=0.5, cap_s=1.0,
+                               sleep=lambda s: None)
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert "probe" in str(ei.value)
+
+    def test_retry_give_up_on_propagates_immediately(self):
+        from lightgbm_tpu.resilience.backoff import retry_with_backoff
+        calls = []
+
+        def injected():
+            calls.append(1)
+            raise faults.FaultInjected("chaos")
+
+        with pytest.raises(faults.FaultInjected):
+            retry_with_backoff(injected, "probe", deadline_s=60.0,
+                               give_up_on=(faults.FaultInjected,),
+                               sleep=lambda s: None)
+        assert len(calls) == 1, \
+            "an injected fault must not be retried away"
+
+    def test_connect_with_retry_rides_the_shared_curve(self):
+        """connect_with_retry after the dedup: same delays as before
+        (0.5 doubling to the 8s cap), NetworkError at the deadline."""
+        attempts = []
+
+        def failing():
+            attempts.append(time.monotonic())
+            raise OSError("refused")
+
+        t0 = time.monotonic()
+        with pytest.raises(net.NetworkError):
+            net.connect_with_retry(failing, "probe", deadline_s=1.5,
+                                   base_delay_s=0.4, max_delay_s=0.8)
+        elapsed = time.monotonic() - t0
+        # attempt 1, sleep 0.4, attempt 2, sleep 0.8, attempt 3 -> the
+        # next 0.8s sleep would cross the 1.5s deadline
+        assert len(attempts) == 3
+        assert elapsed < 5.0
