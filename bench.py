@@ -856,6 +856,201 @@ def run_serving_scale_bench():
     return out
 
 
+SERVE_LOWLAT_RPS = [int(r) for r in os.environ.get(
+    "BENCH_SERVE_LOWLAT_RPS", "40,400").split(",") if r.strip()]
+SERVE_FLEET_N = int(os.environ.get("BENCH_SERVE_FLEET_MODELS", 64))
+
+
+def run_serving_lowlat_bench():
+    """The low-latency lane's headline: open-loop fixed-RPS SINGLE-ROW
+    latency with serve_low_latency on vs off, same bodies, byte-equal
+    responses required across the lanes.  At low RPS the off-server
+    pays the coalescing window on nearly every request; the lane
+    answers synchronously, so its p50/p99 measure the actual descend+
+    format cost."""
+    import urllib.request
+
+    os.makedirs(CACHE, exist_ok=True)
+    model = os.path.join(CACHE, "bench_serve_model.txt")
+    if not os.path.exists(model):
+        with open(model, "w") as f:
+            f.write(_serve_model_text())
+    rng = np.random.RandomState(SEED + 17)
+    bodies = []
+    for _ in range(32):
+        row = rng.randn(1, N_FEAT)[0]
+        bodies.append(("0\t" + "\t".join("%.6g" % v for v in row)
+                       + "\n").encode())
+    # the low-latency tier's shipped shape is the jax-free native
+    # process (the single-row fast path): both legs run it so the A-B
+    # isolates the ADMISSION decision, not the engine
+    common = ["input_model=" + model, "metric_freq=100", "verbose=0",
+              "serve_backend=native",
+              "serve_max_batch_rows=4096", "serve_batch_timeout_ms=2"]
+    out = {"serve_lowlat_rps_sweep": SERVE_LOWLAT_RPS}
+    want = None
+    for lane in ("off", "on"):
+        proc, port, log_f = _spawn_serve(
+            common + ["serve_low_latency=%s" % lane],
+            log_name="bench_serve_lane_%s.log" % lane)
+        try:
+            got = []
+            for b in bodies:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:%d/predict" % port, data=b)
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    got.append(r.read())
+            if want is None:
+                want = got
+            # lane routing must never change a response byte
+            assert got == want, \
+                "lane %s responses diverged from lane-off bytes" % lane
+            # sequential closed-loop leg: one keep-alive client, the
+            # cleanest single-row number (no client-side contention) —
+            # the lane-off row pays the coalescing window every time
+            import http.client
+            import socket
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+            seq = []
+            for i in range(260):
+                t0 = time.monotonic()
+                conn.request("POST", "/predict",
+                             bodies[i % len(bodies)])
+                conn.getresponse().read()
+                seq.append(time.monotonic() - t0)
+            conn.close()
+            seq = sorted(seq[10:])    # drop the warm-up head
+            out["serve_lane_%s_seq_p50_ms" % lane] = round(
+                seq[len(seq) // 2] * 1e3, 3)
+            out["serve_lane_%s_seq_p99_ms" % lane] = round(
+                seq[int(len(seq) * 0.99)] * 1e3, 3)
+            for rps in SERVE_LOWLAT_RPS:
+                lat, lagged = _serve_open_loop(
+                    port, bodies, want, rps, SERVE_OPEN_SECS)
+                tag = "serve_lane_%s_rps%d" % (lane, rps)
+                out[tag + "_p50_ms"] = round(
+                    lat[len(lat) // 2] * 1e3, 3)
+                out[tag + "_p99_ms"] = round(
+                    lat[int(len(lat) * 0.99)] * 1e3, 3)
+                out[tag + "_lagged"] = lagged
+        finally:
+            _stop_serve(proc, log_f)
+    for rps in SERVE_LOWLAT_RPS:
+        off = out["serve_lane_off_rps%d_p99_ms" % rps]
+        on = out["serve_lane_on_rps%d_p99_ms" % rps]
+        out["serve_lane_p99_gain_rps%d" % rps] = \
+            round(off / on, 3) if on > 0 else None
+    if out.get("serve_lane_on_seq_p50_ms"):
+        out["serve_lane_seq_p50_gain"] = round(
+            out["serve_lane_off_seq_p50_ms"]
+            / out["serve_lane_on_seq_p50_ms"], 3)
+    return out
+
+
+def run_serving_fleet_bench():
+    """Fleet scale-out sweep: SERVE_FLEET_N registered models through a
+    16-slot warm pool.  Warm-hit throughput must stay in family with
+    the single-model server (the pool adds a dict hop, not a load),
+    and cold-hit latency — a full parse + lazy warm on the request
+    path — stays bounded because device-bucket compiles are deferred."""
+    import urllib.parse
+    import urllib.request
+
+    os.makedirs(CACHE, exist_ok=True)
+    fdir = os.path.join(CACHE, "bench_fleet_models")
+    os.makedirs(fdir, exist_ok=True)
+    base = _serve_model_text()
+    models = []
+    for i in range(SERVE_FLEET_N):
+        p = os.path.join(fdir, "m%03d.txt" % i)
+        if not os.path.exists(p):
+            with open(p, "w") as f:
+                f.write(base)
+        models.append(p)
+    rng = np.random.RandomState(SEED + 19)
+    row = rng.randn(1, N_FEAT)[0]
+    body = ("0\t" + "\t".join("%.6g" % v for v in row) + "\n").encode()
+    pool = 16
+    params = ["input_model=" + models[0],
+              "serve_models=" + ",".join(models[1:pool]),
+              "serve_fleet_max_models=%d" % pool,
+              "metric_freq=100", "verbose=0",
+              "serve_max_batch_rows=4096", "serve_batch_timeout_ms=2"]
+    proc, port, log_f = _spawn_serve(params,
+                                     log_name="bench_serve_fleet.log")
+    try:
+        def post_model(path):
+            q = ("?model=" + urllib.parse.quote(path, safe="")) \
+                if path else ""
+            t0 = time.monotonic()
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict%s" % (port, q), data=body)
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out_b = r.read()
+            return time.monotonic() - t0, out_b
+
+        # register the cold tail through the deploy-push /reload shape
+        # ({"model":.., "default": false}) so cold hits are exercised
+        # via ?model=
+        for p in models[pool:]:
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/reload" % port,
+                data=json.dumps({"model": p,
+                                 "default": False}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=120).read()
+        # warm-hit phase: round-robin the resident models
+        warm_paths = models[:pool]
+        for p in warm_paths:          # touch once: everyone resident
+            post_model(p)
+        n_warm = 300
+        t0 = time.monotonic()
+        warm_lat = []
+        want = {}
+        for i in range(n_warm):
+            p = warm_paths[i % len(warm_paths)]
+            dt, got = post_model(p)
+            warm_lat.append(dt)
+            if p in want:
+                assert want[p] == got, "warm-hit bytes diverged"
+            want[p] = got
+        warm_wall = time.monotonic() - t0
+        # single-model control on the same server: default model only
+        t0 = time.monotonic()
+        for _ in range(n_warm):
+            post_model(None)
+        single_wall = time.monotonic() - t0
+        # cold-hit phase: churn ALL models through the 16-slot pool —
+        # every request past the pool is a parse + lazy warm
+        cold_lat = []
+        for sweep in range(2):
+            for p in models:
+                dt, _ = post_model(p)
+                cold_lat.append(dt)
+        warm_lat.sort()
+        cold_lat.sort()
+        return {
+            "serve_fleet_models": SERVE_FLEET_N,
+            "serve_fleet_pool": pool,
+            "serve_fleet_warm_rps": round(n_warm / warm_wall, 1),
+            "serve_fleet_single_rps": round(n_warm / single_wall, 1),
+            "serve_fleet_warm_vs_single": round(
+                single_wall / warm_wall, 3),
+            "serve_fleet_warm_p99_ms": round(
+                warm_lat[int(len(warm_lat) * 0.99)] * 1e3, 3),
+            "serve_fleet_cold_p50_ms": round(
+                cold_lat[len(cold_lat) // 2] * 1e3, 3),
+            "serve_fleet_cold_p99_ms": round(
+                cold_lat[int(len(cold_lat) * 0.99)] * 1e3, 3),
+        }
+    finally:
+        _stop_serve(proc, log_f)
+
+
 def ensure_ref_binary():
     exe = os.path.join(REF_BUILD, "ref_src", "lightgbm")
     if os.path.exists(exe):
@@ -1474,6 +1669,19 @@ def main():
             extras.update(run_serving_scale_bench())
         except Exception as e:
             extras["serve_scale_error"] = str(e)[:200]
+        # low-latency lane A-B (serving/flatforest.py + admission lane):
+        # open-loop fixed-RPS single-row p50/p99, lane on vs off,
+        # byte-equal responses required across the lanes
+        try:
+            extras.update(run_serving_lowlat_bench())
+        except Exception as e:
+            extras["serve_lowlat_error"] = str(e)[:200]
+        # fleet scale-out sweep (serving/fleet.py): warm-hit throughput
+        # vs single-model + cold-hit latency through the bounded pool
+        try:
+            extras.update(run_serving_fleet_bench())
+        except Exception as e:
+            extras["serve_fleet_error"] = str(e)[:200]
 
     if os.environ.get("BENCH_INGEST", "1") != "0":
         # out-of-core ingest throughput (dense + LibSVM) + the shard-fed

@@ -132,6 +132,10 @@ EXPECTED_JAX_FREE: Tuple[str, ...] = (
     "parallel/__init__.py", "parallel/dist.py",
     "serving/__init__.py", "serving/forest.py", "serving/batcher.py",
     "serving/server.py", "serving/fleet.py", "serving/frontend.py",
+    # the low-latency lane: the flat-table engine and the host-side
+    # rank-encode pack builder it shares with the device matmul route
+    # both serve inside backend=native worker processes
+    "serving/flatforest.py", "ops/predict_host.py",
     "utils/__init__.py", "utils/log.py", "utils/mt19937.py",
     "utils/compile_cache.py",
     # the fault-tolerance layer rides inside the jax-free fast paths

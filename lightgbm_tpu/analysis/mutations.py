@@ -159,6 +159,13 @@ def _t_lazy_jax_in_get_lib(src: str) -> str:
         what="lazy jax import into native.get_lib")
 
 
+def _t_lazy_jax_in_compile_flat(src: str) -> str:
+    return _insert_before(
+        src, "    th, tl = split_hi_lo(thr)\n",
+        "    import jax  # seeded violation\n",
+        what="lazy jax import into flatforest.compile_flat")
+
+
 # ---------------------------------------------------------------------------
 # parity_oracle — oracle set drift + RNG/clock reach
 # ---------------------------------------------------------------------------
@@ -200,6 +207,13 @@ def _t_unlocked_observe_in_server(src: str) -> str:
         src, "    def request_started(self, endpoint: str) -> None:\n",
         "        self.latency.observe(0.0)  # seeded violation\n",
         what="unlocked observe() into Metrics.request_started")
+
+
+def _t_unlocked_lane_observe(src: str) -> str:
+    return _insert_after(
+        src, "    def request_started(self, endpoint: str) -> None:\n",
+        "        self._lane_observe(\"fast\", 0.0)  # seeded violation\n",
+        what="unlocked _lane_observe() into Metrics.request_started")
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +438,12 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "a lazy `import jax` inside native.get_lib — reached from the "
        "@contract.jax_free fast-predict / serving fallback closures",
        _t_lazy_jax_in_get_lib),
+    _m("lazy-jax-in-compile-flat", "jax_free", "serving/flatforest.py",
+       "GC002", "serving/flatforest.py", "lazy jax import",
+       "a lazy `import jax` inside the flat-table compiler — "
+       "compile_flat runs in warm() on the low-latency lane of a "
+       "backend=native process and is @contract.jax_free",
+       _t_lazy_jax_in_compile_flat),
 
     _m("jax-into-ingest-writer", "jax_free", "ingest/writer.py",
        "GC002", "ingest/writer.py", "jax",
@@ -473,6 +493,13 @@ MUTATIONS: Tuple[Mutation, ...] = (
        "Metrics.request_started calling _Histogram.observe outside "
        "`with self._lock`",
        _t_unlocked_observe_in_server),
+    _m("unlocked-lane-observe-in-server", "locked_by",
+       "serving/server.py", "GC004", "serving/server.py",
+       "_lane_observe",
+       "Metrics.request_started calling the per-lane latency recorder "
+       "outside `with self._lock` — the lane counters and histograms "
+       "share the metrics lock",
+       _t_unlocked_lane_observe),
 
     _m("fused-annotation-removed", "fused_body", "models/gbdt.py",
        "GC005", "models/gbdt.py", "missing its @contract.fused_body",

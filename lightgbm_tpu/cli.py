@@ -74,7 +74,12 @@ class Application:
         elif self.config.task == "serve":
             # warm-model HTTP prediction service (serving/): jax imports
             # lazily inside the forest only when its engine is selected,
-            # so serve_backend=native keeps the jax-free startup profile
+            # so serve_backend=native keeps the jax-free startup
+            # profile — including the low-latency lane, whose flat-table
+            # engine (serving/flatforest.py) is jax-free by contract
+            log.info("serve: low-latency lane %s (serve_low_latency_"
+                     "max_rows=%d)" % (self.config.serve_low_latency,
+                                       self.config.serve_low_latency_max_rows))
             if self.config.serve_workers > 1:
                 # multi-process front-end: the SUPERVISOR stays jax-free
                 # (it only forks and watches); each spawned worker

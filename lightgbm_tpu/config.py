@@ -292,10 +292,40 @@ class Config:
     #                                       multi-model fleet at startup
     #                                       (serving/fleet.py); reachable
     #                                       via /predict?model=<path>
-    serve_fleet_max_models: int = 4       # LRU warm-pool capacity: at
-    #                                       most this many forests stay
-    #                                       warm; registered models past
-    #                                       it re-warm on demand
+    serve_fleet_max_models: int = 64      # warm-pool capacity: at most
+    #                                       this many forests stay warm
+    #                                       (LRU + age eviction below);
+    #                                       registered models past it
+    #                                       re-warm on demand.  Cold
+    #                                       fleet loads warm LAZILY
+    #                                       (flat table + host packs
+    #                                       only), so the pool scales
+    #                                       toward thousands of
+    #                                       per-tenant models
+    serve_fleet_evict_age_s: float = 0.0  # age-based fleet eviction:
+    #                                       warm non-default models idle
+    #                                       longer than this drop from
+    #                                       the pool (they stay
+    #                                       registered and re-warm on
+    #                                       the next hit); 0 = LRU
+    #                                       capacity only
+    serve_low_latency: str = "auto"       # auto | on | off: the
+    #                                       latency-class admission lane
+    #                                       — requests of at most
+    #                                       serve_low_latency_max_rows
+    #                                       rows skip the micro-batcher's
+    #                                       coalescing window and
+    #                                       dispatch synchronously on
+    #                                       the jax-free flat-table
+    #                                       engine (serving/flatforest).
+    #                                       auto clamps the row bound
+    #                                       below serve_matmul_min_rows;
+    #                                       on fatals on that
+    #                                       contradiction instead
+    serve_low_latency_max_rows: int = 16  # largest request (rows) the
+    #                                       fast lane admits; bigger
+    #                                       requests ride the coalesced
+    #                                       batch path
 
     # -- out-of-core ingestion (ingest/) ---------------------------------
     ingest_dir: str = ""                  # task=ingest output directory
@@ -564,6 +594,9 @@ class Config:
         set_int("serve_matmul_min_rows")
         set_str("serve_models")
         set_int("serve_fleet_max_models")
+        set_float("serve_fleet_evict_age_s")
+        set_str("serve_low_latency")
+        set_int("serve_low_latency_max_rows")
         set_str("ingest_dir")
         set_int("ingest_memory_budget_mb")
         set_int("ingest_shard_rows")
@@ -613,6 +646,27 @@ class Config:
             log.fatal("serve_matmul_min_rows must be >= 1")
         if c.serve_fleet_max_models < 1:
             log.fatal("serve_fleet_max_models must be >= 1")
+        if c.serve_fleet_evict_age_s < 0:
+            log.fatal("serve_fleet_evict_age_s must be >= 0")
+        if c.serve_low_latency not in ("auto", "on", "off"):
+            log.fatal("Unknown serve_low_latency %s (expect auto|on|off)"
+                      % c.serve_low_latency)
+        if c.serve_low_latency_max_rows < 1:
+            log.fatal("serve_low_latency_max_rows must be >= 1")
+        if c.serve_low_latency == "on" \
+                and c.serve_low_latency_max_rows \
+                >= c.serve_matmul_min_rows:
+            # contradictory routing: the forced-on fast lane would eat
+            # batches the matmul route is configured to serve.  auto
+            # resolves this by clamping the lane bound below the
+            # threshold; forcing both is a config error, not a silent
+            # precedence pick
+            log.fatal("serve_low_latency_max_rows (%d) must be below "
+                      "serve_matmul_min_rows (%d) with "
+                      "serve_low_latency=on; lower the lane bound or "
+                      "use serve_low_latency=auto (it clamps)"
+                      % (c.serve_low_latency_max_rows,
+                         c.serve_matmul_min_rows))
         if c.ingest_memory_budget_mb < 8:
             log.fatal("ingest_memory_budget_mb must be >= 8")
         if c.ingest_shard_rows < 0:

@@ -17,6 +17,13 @@ enable_compilation_cache()   # before any jit traces (was a package-import side 
 import jax
 import jax.numpy as jnp
 
+# host-side exact-compare helpers live in predict_host.py (pure numpy,
+# importable from jax-free lanes); re-exported here for the historical
+# import site every route uses
+from .predict_host import (matmul_host_arrays, order_key,  # noqa: F401
+                           rank_encode, split_hi_lo,
+                           threshold_rank_tables)
+
 
 @jax.jit
 def predict_leaf_binned(split_feature: jax.Array, threshold_bin: jax.Array,
@@ -79,38 +86,6 @@ def predict_leaf_raw(split_feature_real: jax.Array, threshold: jax.Array,
     return ~node
 
 
-def split_hi_lo(a: "np.ndarray"):
-    """Order-isomorphic encoding of f64 values as (hi, lo) uint32 pairs.
-
-    The device never needs x64: each double's bit pattern is mapped on
-    the HOST to a uint64 whose unsigned order equals the IEEE-754 total
-    order (negatives bit-flipped, positives sign-bit-set — the classic
-    radix-sortable-float transform), then split into two uint32 words.
-    Lexicographic compare of the pairs reproduces the f64 `<=` EXACTLY
-    for every finite value, ±1e308 (the parser's inf mapping), and
-    subnormals — no precision loss, int ops only on device.  -0.0 is
-    normalized to +0.0 first (IEEE `<=` treats them equal); NaN maps to
-    the largest key, so `value <= threshold` is false and NaN rows take
-    the right child, matching the reference's failed double compare
-    (tree.h:179-189)."""
-    import numpy as np
-    # one mutable working copy + in-place bit math: the naive
-    # np.where chain built ~5 full-size temporaries, which dominated
-    # peak memory for wide chunks (sparse prediction)
-    a = np.array(a, dtype=np.float64, copy=True)
-    nan = np.isnan(a)
-    np.copyto(a, 0.0, where=(a == 0.0))     # -0.0 -> +0.0
-    neg = np.signbit(a)                     # bit-level sign (incl. -nan)
-    bits = a.view(np.uint64)
-    bits ^= np.uint64(0x8000000000000000)   # non-negatives: set sign bit
-    bits[neg] ^= np.uint64(0x7FFFFFFFFFFFFFFF)  # negatives: full flip
-    bits[nan] = np.uint64(0xFFFFFFFFFFFFFFFF)
-    lo = bits.astype(np.uint32)             # u64 -> u32 keeps the low word
-    bits >>= np.uint64(32)
-    hi = bits.astype(np.uint32)
-    return hi, lo
-
-
 def _leaf_hi_lo_inner(split_feature_real, thr_hi, thr_lo, left_child,
                       right_child, x_hi, x_lo):
     """One tree's descent for all rows: value <= threshold via exact
@@ -134,107 +109,6 @@ def _leaf_hi_lo_inner(split_feature_real, thr_hi, thr_lo, left_child,
         return jnp.where(node >= 0, nxt, node)
 
     return ~jax.lax.while_loop(cond, body, node)
-
-
-def order_key(hi: "np.ndarray", lo: "np.ndarray") -> "np.ndarray":
-    """(hi, lo) uint32 pair -> uint64 order key.  The ONE definition both
-    the model pack (threshold ranks) and rank_encode (value codes) use —
-    the matmul predictor's exactness rests on the two sides agreeing."""
-    import numpy as np
-    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
-
-
-def rank_encode(hi: "np.ndarray", lo: "np.ndarray",
-                tables: "list") -> "np.ndarray":
-    """Host-side exact rank encoding of raw values against the MODEL's
-    per-feature threshold tables (prediction-time binning).
-
-    tables[f] is the sorted array of uint64 order keys (split_hi_lo) of
-    every threshold the model compares feature f against.  code(x) =
-    searchsorted(table, key(x)) satisfies  x <= thr[i]  <=>  code(x) <=
-    rank(thr[i])  EXACTLY in the f64 total order — and the codes are
-    tiny integers, so the device upload is uint16 instead of raw keys
-    (16x fewer bytes, the remote-tunnel predict bottleneck) and the
-    selection matmul needs a single exactly-representable plane."""
-    import numpy as np
-    key = order_key(hi, lo)
-    out = np.zeros(hi.shape, dtype=np.uint16)
-    for f, table in enumerate(tables):
-        if len(table):
-            out[:, f] = np.searchsorted(table, key[:, f],
-                                        side="left").astype(np.uint16)
-    return out
-
-
-def matmul_host_arrays(trees, sf, th, tl, lc, rc, max_l, m, ftot,
-                       tree_block):
-    """Host-side arrays for the gather-free matmul predictor, shared by
-    the batch path (models/gbdt.py _matmul_pack) and the serving forest
-    (serving/forest.py) so the two packs cannot drift: one-hot feature
-    selection, per-feature threshold rank tables (for rank_encode) +
-    node rank codes, and per-tree path matrices.
-
-    trees: the Tree list; sf/th/tl/lc/rc: the [T, M] padded node arrays
-    (split_hi_lo threshold words); ftot: model feature width;
-    tree_block: scan block multiple the tree count pads to.  Returns
-    (tables, sel, thr_code, pos, neg, depth) as numpy arrays, or None
-    when the pack declines (wide-feature selection matrix, uint16 code
-    overflow) and the descent path should serve instead.
-    """
-    import numpy as np
-    t_cnt = len(trees)
-    # pad the tree count to the scan's block multiple; dummy trees
-    # have an all-zero path and depth[0] = 0, so they argmax to leaf
-    # 0 and are sliced off by the caller
-    t_pad = -(-t_cnt // tree_block) * tree_block
-    if ftot * t_pad * m > (1 << 26):
-        # wide-feature models would make the one-hot selection
-        # matrix hundreds of MB (e.g. 200k sparse features); the
-        # descent path handles those instead
-        return None
-    sel = np.zeros((ftot, t_pad * m), dtype=np.float32)
-    real = np.zeros((t_cnt, m), dtype=bool)
-    for i in range(t_cnt):
-        ni = trees[i].num_leaves - 1
-        real[i, :ni] = True
-        for j in range(ni):
-            sel[sf[i, j], i * m + j] = 1.0
-    key = ((th.astype(np.uint64) << np.uint64(32))
-           | tl.astype(np.uint64))            # [T, M] order keys
-    tables = []
-    for f in range(ftot):
-        sel_f = real & (sf == f)
-        tables.append(np.unique(key[sel_f]))
-    if max(len(t) for t in tables) >= 65535:
-        return None   # uint16 codes overflow; descent path instead
-    thr_code = np.zeros(t_pad * m, dtype=np.float32)
-    for i in range(t_cnt):
-        for j in range(trees[i].num_leaves - 1):
-            thr_code[i * m + j] = np.searchsorted(
-                tables[sf[i, j]], key[i, j], side="left")
-    pos = np.zeros((t_pad, m, max_l), dtype=np.float32)
-    neg = np.zeros((t_pad, m, max_l), dtype=np.float32)
-    depth = np.full((t_pad, max_l), np.inf, dtype=np.float32)
-    depth[t_cnt:, 0] = 0.0
-    for i, t in enumerate(trees):
-        # DFS from the root: child >= 0 is an internal node, ~child
-        # is a leaf (tree.py wire format)
-        stack = [(0, [])] if t.num_leaves > 1 else []
-        if t.num_leaves == 1:
-            depth[i, 0] = 0.0
-        while stack:
-            node, path = stack.pop()
-            for child, sign in ((lc[i, node], 1.0),
-                                (rc[i, node], -1.0)):
-                cpath = path + [(node, sign)]
-                if child < 0:
-                    leaf = ~child
-                    depth[i, leaf] = len(cpath)
-                    for nd, sg in cpath:
-                        (pos if sg > 0 else neg)[i, nd, leaf] = 1.0
-                else:
-                    stack.append((int(child), cpath))
-    return tables, sel, thr_code, pos, neg, depth
 
 
 @functools.partial(jax.jit, static_argnames=("tree_block",))

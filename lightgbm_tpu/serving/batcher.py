@@ -151,6 +151,13 @@ class MicroBatcher:
                 raise it.error
         return [it.result for it in items]
 
+    def queue_depth(self) -> int:
+        """Segments waiting for a dispatch right now (the /metrics
+        gauge that makes the lane routing decision observable: a deep
+        queue is exactly the state the fast lane exists to bypass)."""
+        with self._cv:
+            return len(self._queue)
+
     # -- worker side -----------------------------------------------------
     @contract.locked_by("_cv")
     def _take_batch(self) -> List[_Item]:

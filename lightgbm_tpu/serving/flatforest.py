@@ -1,0 +1,168 @@
+"""Flat quantized node-array engine — the serving fast path's forest.
+
+The low-latency lane answers single-digit-row requests synchronously on
+the host, so its per-request cost must be a handful of vector ops, not
+a per-tree Python loop.  At warm() the forest compiles ONCE into a
+contiguous struct-of-arrays node table:
+
+    feat[n]          int32   split feature id of flat node n
+    thr_rank[n]      int32   bin-rank-encoded threshold (see below)
+    left[n]/right[n] int32   flat child index; ~leaf_id when the child
+                             is a leaf (the models/tree.py wire rule)
+    default_left[n]  bool    direction a missing/NaN value takes
+                             (derived: always False for this model
+                             family — NaN order-keys above every
+                             threshold, tree.h:179-189)
+
+Trees concatenate back-to-back (`root[t]` indexes tree t's root; an
+unsplit stump stores root[t] = ~0 so every row lands in leaf 0), and
+descent is a vectorized numpy loop over [N, T] node cursors — one
+gather + compare per tree LEVEL, not per node, exactly the stacked
+device kernel's shape but on the host and jax-free.
+
+Thresholds are not stored as f64: each node holds the RANK of its
+threshold in its feature's sorted threshold-key table, built by the
+SAME pack builder the device matmul route uses
+(ops/predict_host.threshold_rank_tables, the shared half of
+matmul_host_arrays).  Request values rank-encode against those same
+tables (ops/predict_host.rank_encode), and
+
+    code(x) <= rank(thr)   <=>   x <= thr     (exact f64 total order)
+
+so the flat engine's leaf indices are identical to the descent and
+matmul routes' BY CONSTRUCTION — one threshold source, three routes,
+no drift (tests/test_serving_fastlane.py pins the bytes against both
+the batch path and task=predict).
+"""
+
+from __future__ import annotations
+
+__jax_free__ = True
+
+from typing import List
+
+import numpy as np
+
+from ..analysis.contracts import contract
+from ..models.tree import Tree
+from ..ops.predict_host import (rank_encode, split_hi_lo,
+                                threshold_rank_tables)
+
+
+class FlatForest:
+    """The compiled flat node table + its rank tables (immutable)."""
+
+    __slots__ = ("feat", "thr_rank", "left", "right", "default_left",
+                 "root", "tables", "num_trees", "max_depth")
+
+    def __init__(self, feat: np.ndarray, thr_rank: np.ndarray,
+                 left: np.ndarray, right: np.ndarray,
+                 default_left: np.ndarray, root: np.ndarray,
+                 tables: List[np.ndarray], max_depth: int):
+        self.feat = feat
+        self.thr_rank = thr_rank
+        self.left = left
+        self.right = right
+        self.default_left = default_left
+        self.root = root
+        self.tables = tables
+        self.num_trees = root.shape[0]
+        self.max_depth = max_depth
+
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        """[N, F] f64 -> [N, F] int32 rank codes against the model's
+        threshold tables (the same encoding the matmul route uploads,
+        minus its uint16 size cap — host compares never overflow)."""
+        xh, xl = split_hi_lo(x)
+        return rank_encode(xh, xl, self.tables, dtype=np.int32)
+
+    def leaves(self, x: np.ndarray) -> np.ndarray:
+        """[N, F] f64 rows -> [N, T] int64 leaf indices."""
+        return self.leaves_coded(self.encode(x))
+
+    def leaves_coded(self, code: np.ndarray) -> np.ndarray:
+        """Vectorized descent over the flat table: all rows x all trees
+        step down one level per iteration (<= max_depth iterations)."""
+        n = code.shape[0]
+        t = self.num_trees
+        # node cursor per (row, tree): >= 0 is a flat node index still
+        # descending, negative is ~leaf_id done
+        node = np.repeat(self.root[None, :], n, axis=0)
+        for _ in range(self.max_depth):
+            active = node >= 0
+            if not active.any():
+                break
+            idx = np.where(active, node, 0)
+            f = self.feat[idx]                               # [N, T]
+            v = np.take_along_axis(code, f, axis=1)          # [N, T]
+            nxt = np.where(v <= self.thr_rank[idx],
+                           self.left[idx], self.right[idx])
+            node = np.where(active, nxt, node)
+        return (~node).astype(np.int64)
+
+    def nbytes(self) -> int:
+        """Resident size of the node table + rank tables (fleet-sizing
+        introspection: /healthz reports it per warm model)."""
+        n = sum(int(a.nbytes) for a in
+                (self.feat, self.thr_rank, self.left, self.right,
+                 self.default_left, self.root))
+        return n + sum(int(tb.nbytes) for tb in self.tables)
+
+
+@contract.jax_free
+def compile_flat(trees: List[Tree], sf: np.ndarray, thr: np.ndarray,
+                 lc: np.ndarray, rc: np.ndarray, ftot: int) -> FlatForest:
+    """[T, M] padded node arrays -> the contiguous flat table.
+
+    @contract.jax_free: this compiler runs inside warm() on the serving
+    fast path of a backend=native process — graftcheck GC002 verifies
+    it can never pull jax into that process.  sf/thr/lc/rc are the
+    forest's `_flat_arrays()` (the SAME arrays the device packs build
+    from); ftot is the model feature width."""
+    th, tl = split_hi_lo(thr)
+    tables, key, _ = threshold_rank_tables(trees, sf, th, tl, ftot)
+    ni = np.array([tr.num_leaves - 1 for tr in trees], dtype=np.int64)
+    off = np.zeros(len(trees) + 1, dtype=np.int64)
+    np.cumsum(ni, out=off[1:])
+    total = int(off[-1])
+    feat = np.zeros(total, dtype=np.int32)
+    thr_rank = np.zeros(total, dtype=np.int32)
+    left = np.full(total, -1, dtype=np.int32)
+    right = np.full(total, -1, dtype=np.int32)
+    root = np.full(len(trees), -1, dtype=np.int32)   # stump: ~0 -> leaf 0
+    max_depth = 0
+    for i in range(len(trees)):
+        n = int(ni[i])
+        if n == 0:
+            continue
+        o = int(off[i])
+        root[i] = o
+        s = slice(o, o + n)
+        feat[s] = sf[i, :n]
+        for j in range(n):
+            thr_rank[o + j] = np.searchsorted(
+                tables[sf[i, j]], key[i, j], side="left")
+        # rebase internal children to flat indices; leaves stay ~leaf_id
+        l = lc[i, :n].astype(np.int32)
+        r = rc[i, :n].astype(np.int32)
+        left[s] = np.where(l >= 0, l + o, l)
+        right[s] = np.where(r >= 0, r + o, r)
+        # deepest compare chain bounds the descent loop
+        stack = [(0, 1)]
+        while stack:
+            node, d = stack.pop()
+            if d > max_depth:
+                max_depth = d
+            for child in (int(lc[i, node]), int(rc[i, node])):
+                if child >= 0:
+                    stack.append((child, d + 1))
+    # default direction: the route a NaN value's code takes at each
+    # node.  NaN order-keys to the maximum uint64, so its rank lands
+    # past every table entry and the compare sends it right — recorded
+    # per node so the layout carries the bit explicitly instead of
+    # implying it
+    nan_code = np.array([len(tables[int(f)]) for f in feat],
+                        dtype=np.int64)
+    default_left = nan_code <= thr_rank
+    return FlatForest(feat, thr_rank, left, right, default_left, root,
+                      tables, max_depth)

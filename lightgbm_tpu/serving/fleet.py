@@ -1,12 +1,21 @@
-"""Multi-model fleet: N hot models behind an LRU warm pool.
+"""Multi-model fleet: N hot models behind an LRU + age warm pool.
 
-One server process can hold several models warm at once — the A/B and
-shadow-traffic shapes production serving actually runs: the default
-model answers `/predict`, `/predict?model=<path>` routes to any
-REGISTERED model (loading + warming it on first use), and an LRU pool
-bounds how many forests stay resident (`serve_fleet_max_models`).
-Registered models past the bound re-warm on demand; the default model
-is pinned and never evicted.
+One server process can hold many models warm at once — the per-tenant
+shape production serving actually runs: the default model answers
+`/predict`, `/predict?model=<path>` routes to any REGISTERED model
+(loading + warming it on first use), and the warm pool bounds how many
+forests stay resident two ways: LRU capacity (`serve_fleet_max_models`)
+and idle age (`serve_fleet_evict_age_s` — a warm model untouched that
+long drops at the next pool access).  Registered models past either
+bound re-warm on demand; the default model is pinned and never evicted.
+
+Cold loads warm LAZILY (forest.warm(lazy=True)): the flat table and
+host packs build immediately — the low-latency lane serves the very
+first hit — while device bucket executables compile on the first routed
+batch (the jit cache keys on shapes, so same-shaped fleet models reuse
+already-compiled executables).  That keeps a cold hit to parse + pack
+cost, which is what lets the pool scale toward thousands of per-tenant
+models instead of 4.
 
 Batches can never coalesce across models: the batcher keys on the
 ServingForest itself, whose __eq__/__hash__ compare the EXPLICIT
@@ -25,6 +34,7 @@ from __future__ import annotations
 __jax_free__ = True
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
@@ -50,10 +60,14 @@ class ModelFleet:
     def __init__(self, cfg: Config, default_forest: ServingForest):
         self.cfg = cfg
         self.max_models = int(cfg.serve_fleet_max_models)
+        self.evict_age_s = float(cfg.serve_fleet_evict_age_s)
         self._lock = threading.Lock()        # pool + registry state
         self._load_lock = threading.Lock()   # serializes cold loads
         default_path = default_forest.source
         self._default_path = default_path
+        # last pool access per path (monotonic), for age eviction
+        self._last_used: Dict[str, float] = {
+            default_path: time.monotonic()}
         # path -> warm forest, in LRU order (last = most recent)
         self._pool: "OrderedDict[str, ServingForest]" = OrderedDict()
         self._pool[default_path] = default_forest
@@ -76,6 +90,7 @@ class ModelFleet:
         with self._lock:
             forest = self._pool[self._default_path]
             self._pool.move_to_end(self._default_path)
+            self._last_used[self._default_path] = time.monotonic()
             return forest
 
     def contains(self, forest: ServingForest) -> bool:
@@ -93,9 +108,11 @@ class ModelFleet:
         with self._lock:
             if path not in self._registered:
                 raise UnknownModelError(path)
+            self._evict_stale()
             forest = self._pool.get(path)
             if forest is not None:
                 self._pool.move_to_end(path)
+                self._last_used[path] = time.monotonic()
                 return forest
         return self._load(path)
 
@@ -128,8 +145,10 @@ class ModelFleet:
             self._registered[path] = True
             self._pool[path] = fresh
             self._pool.move_to_end(path)
+            self._last_used[path] = time.monotonic()
             if make_default:
                 self._default_path = path
+            self._evict_stale()
             self._evict_over_capacity()
         return fresh
 
@@ -146,6 +165,8 @@ class ModelFleet:
             with self._lock:
                 self._pool[path] = fresh
                 self._pool.move_to_end(path)
+                self._last_used[path] = time.monotonic()
+                self._evict_stale()
                 self._evict_over_capacity()
             return fresh
 
@@ -156,8 +177,13 @@ class ModelFleet:
                              backend=cfg.serve_backend,
                              matmul=cfg.serve_matmul,
                              matmul_min_rows=cfg.serve_matmul_min_rows)
-        forest.warm(cfg.serve_max_batch_rows)
-        log.info("Fleet: warmed %s (%d trees, sha %s)"
+        # lazy warm: flat table + host packs NOW (the fast lane serves
+        # the first hit), device buckets on first routed batch — the
+        # cold-hit cost stays bounded at thousand-model fleet scale.
+        # Operator paths that want eager buckets (startup preload,
+        # /reload) call warm() again themselves.
+        forest.warm(cfg.serve_max_batch_rows, lazy=True)
+        log.info("Fleet: lazily warmed %s (%d trees, sha %s)"
                  % (path, forest.num_models, forest.content_sha[:12]))
         return forest
 
@@ -172,8 +198,28 @@ class ModelFleet:
             if victim is None:
                 return
             evicted = self._pool.pop(victim)
+            self._last_used.pop(victim, None)
             log.info("Fleet: evicted %s (sha %s) from the warm pool"
                      % (victim, evicted.content_sha[:12]))
+
+    def _evict_stale(self) -> None:
+        """Called with _lock held: age eviction — non-default forests
+        idle past serve_fleet_evict_age_s drop from the pool (still
+        registered; the next hit lazily re-warms).  At per-tenant scale
+        LRU capacity alone keeps dead tenants resident for hours; age
+        is the bound that actually frees their node tables."""
+        if self.evict_age_s <= 0:
+            return
+        now = time.monotonic()
+        stale = [p for p in self._pool
+                 if p != self._default_path
+                 and now - self._last_used.get(p, now) > self.evict_age_s]
+        for victim in stale:
+            evicted = self._pool.pop(victim)
+            self._last_used.pop(victim, None)
+            log.info("Fleet: evicted %s (sha %s) — idle past %.3gs"
+                     % (victim, evicted.content_sha[:12],
+                        self.evict_age_s))
 
     # -- introspection ---------------------------------------------------
     def warm_models(self) -> List[ServingForest]:

@@ -48,6 +48,7 @@ from ..analysis.contracts import contract
 from ..models.tree import Tree, parse_model_text
 from ..resilience.faults import faultpoint
 from ..utils import log
+from .flatforest import FlatForest, compile_flat
 
 MODES = ("normal", "raw", "leaf")
 
@@ -119,6 +120,7 @@ class ServingForest:
         self._native_spec: Optional[Any] = None
         self._native_spec_tried = False
         self._host_pack: Optional[Dict[str, Any]] = None
+        self._flat: Optional[FlatForest] = None
         # device matmul routing (serve_matmul / serve_matmul_min_rows):
         # batches of >= matmul_min_rows rows dispatch through the
         # gather-free matmul predictor instead of the stacked descent
@@ -284,6 +286,29 @@ class ServingForest:
         return self.matmul_enabled() and self._mm_pack is not None
 
     @contract.jax_free
+    def _build_flat(self) -> FlatForest:
+        """Flat quantized node table for the low-latency lane
+        (serving/flatforest.py): rank-encoded thresholds from the SAME
+        tables the matmul pack builds, vectorized host descent, leaf
+        indices identical to every other route by construction.
+
+        @contract.jax_free: the fast lane serves from this table inside
+        backend=native worker processes — graftcheck GC002 verifies the
+        build can never pull jax in."""
+        if self._flat is None:
+            with self._lock:
+                if self._flat is None:
+                    sf, thr, lc, rc, _ = self._flat_arrays()
+                    self._flat = compile_flat(self.trees, sf, thr, lc,
+                                              rc, self.max_feature_idx + 1)
+        return self._flat
+
+    @property
+    def flat_ready(self) -> bool:
+        """Whether the fast lane can serve without a lazy build."""
+        return self._flat is not None
+
+    @contract.jax_free
     def _build_host_pack(self) -> Dict[str, Any]:
         if self._host_pack is not None:
             return self._host_pack
@@ -339,6 +364,11 @@ class ServingForest:
         (exact rank-encoded compares: leaf indices are IDENTICAL to the
         descent's, tests pin the served bytes)."""
         n = x.shape[0]
+        if engine == "flat":
+            # low-latency lane: vectorized host descent over the flat
+            # quantized node table — jax-free, no device dispatch, leaf
+            # indices identical to both device routes by construction
+            return self._build_flat().leaves(x)
         if (engine or self._engine) == "jax":
             # the device dispatch is a real failure seam (remote TPU
             # tunnel, OOM, backend death): chaos schedules fail it here
@@ -438,7 +468,7 @@ class ServingForest:
         return format_pred_rows(res, mode == "leaf")
 
     # -- warm-up ---------------------------------------------------------
-    def warm(self, max_batch_rows: int) -> int:
+    def warm(self, max_batch_rows: int, lazy: bool = False) -> int:
         """Pre-compile every power-of-two row bucket up to
         max_batch_rows (JAX engine; the host engine just builds its
         packs).  Buckets at or above the matmul threshold compile BOTH
@@ -446,10 +476,24 @@ class ServingForest:
         executable the breaker's stage-1 fallback answers on — so
         steady state stays at zero recompiles even mid-degrade.
         Returns the number of compiled (bucket, route) executables so
-        callers can log/measure."""
+        callers can log/measure.
+
+        lazy=True is the fleet's cold-load mode at thousand-model
+        scale: only the host-side state builds NOW — the flat table
+        (the fast lane serves immediately) and the host packs — while
+        device bucket executables compile on the first routed batch
+        (the jit cache keys on shapes, so same-shaped fleet models hit
+        already-compiled executables anyway)."""
+        # the flat table always builds: the low-latency lane serves
+        # from it regardless of engine, and it doubles as the host
+        # fallback's O(level) descent
+        self._build_flat()
         if self._engine != "jax":
             self._build_host_pack()
             self._native_forest()
+            return 0
+        if lazy:
+            self._build_host_pack()
             return 0
         n_buckets = 0
         b = BUCKET_FLOOR
@@ -482,6 +526,11 @@ class ServingForest:
                        and (self._mm_pack is not None
                             or not self._mm_tried)),
             "matmul_min_rows": self.matmul_min_rows,
+            # fast-lane state: whether the flat table is resident, and
+            # its size (the number fleet capacity planning sums)
+            "flat": self._flat is not None,
+            "flat_bytes": (self._flat.nbytes()
+                           if self._flat is not None else 0),
             "num_models": self.num_models,
             "num_class": self.num_class,
             "max_feature_idx": self.max_feature_idx,

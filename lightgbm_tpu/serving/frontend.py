@@ -180,10 +180,12 @@ class Frontend:
             self._ready_dir = ready_dir
         for idx in range(self.num_workers):
             self._spawn(idx)
-        log.info("Front-end: %d workers on http://%s:%d (pids %s)"
+        log.info("Front-end: %d workers on http://%s:%d (pids %s), "
+                 "low-latency lane %s"
                  % (self.num_workers, self.cfg.serve_host, self.port,
                     ",".join(str(p.pid) for p in self._workers
-                             if p is not None)))
+                             if p is not None),
+                    self.cfg.serve_low_latency))
 
     def _ready_path(self, idx: int) -> str:
         assert self._ready_dir is not None

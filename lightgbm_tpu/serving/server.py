@@ -57,8 +57,12 @@ MAX_BODY_BYTES = 256 << 20   # refuse absurd request bodies outright
 # Prometheus metrics (text exposition format, no client library needed)
 # ---------------------------------------------------------------------------
 
-_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# sub-ms buckets lead: the low-latency lane answers single rows in
+# tens-to-hundreds of microseconds, and a histogram whose first bucket
+# is 1 ms reports every such request as "<= 0.001" — invisible p99
+_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0)
 _BATCH_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
                       2048, 4096, 8192, 16384)
 
@@ -81,17 +85,24 @@ class _Histogram:
                 return
         self.counts[-1] += 1
 
-    def render(self, name: str, help_: str, out: List[str]) -> None:
-        out.append("# HELP %s %s" % (name, help_))
-        out.append("# TYPE %s histogram" % name)
+    def render(self, name: str, help_: str, out: List[str],
+               labels: str = "", with_meta: bool = True) -> None:
+        """`labels` ('lane="fast"') renders a labeled series; families
+        with several labeled histograms emit HELP/TYPE once
+        (with_meta on the first call only)."""
+        if with_meta:
+            out.append("# HELP %s %s" % (name, help_))
+            out.append("# TYPE %s histogram" % name)
+        pre = labels + "," if labels else ""
+        wrap = ("{%s}" % labels) if labels else ""
         cum = 0
         for b, c in zip(self.buckets, self.counts):
             cum += c
-            out.append('%s_bucket{le="%g"} %d' % (name, b, cum))
+            out.append('%s_bucket{%sle="%g"} %d' % (name, pre, b, cum))
         cum += self.counts[-1]
-        out.append('%s_bucket{le="+Inf"} %d' % (name, cum))
-        out.append("%s_sum %.17g" % (name, self.sum))
-        out.append("%s_count %d" % (name, cum))
+        out.append('%s_bucket{%sle="+Inf"} %d' % (name, pre, cum))
+        out.append("%s_sum%s %.17g" % (name, wrap, self.sum))
+        out.append("%s_count%s %d" % (name, wrap, cum))
 
 
 class Metrics:
@@ -114,6 +125,21 @@ class Metrics:
         self.in_flight = 0
         self.latency = _Histogram(_LATENCY_BUCKETS)
         self.batch_rows = _Histogram(_BATCH_ROW_BUCKETS)
+        # per-lane routing observability (serve_low_latency): request
+        # counts + latency histograms keyed by admission lane, so the
+        # fast-vs-batch decision — and what each lane's tail looks
+        # like — is scrapeable instead of inferred
+        self.lane_requests: Dict[str, int] = {"fast": 0, "batch": 0}
+        self.lane_latency: Dict[str, _Histogram] = {
+            "fast": _Histogram(_LATENCY_BUCKETS),
+            "batch": _Histogram(_LATENCY_BUCKETS)}
+
+    @contract.locked_by("_lock")
+    def _lane_observe(self, lane: str, seconds: float) -> None:
+        # lane state shares Metrics._lock with every histogram:
+        # graftcheck GC004 verifies each call site holds it
+        self.lane_requests[lane] = self.lane_requests.get(lane, 0) + 1
+        self.lane_latency[lane].observe(seconds)
 
     def request_started(self, endpoint: str) -> None:
         # the gauge tracks PREDICT work in flight; a /metrics scrape
@@ -124,7 +150,8 @@ class Metrics:
 
     def request_finished(self, endpoint: str, code: int,
                          seconds: float, rows: int = 0,
-                         model: Optional[Tuple[str, str]] = None) -> None:
+                         model: Optional[Tuple[str, str]] = None,
+                         lane: Optional[str] = None) -> None:
         with self._lock:
             if endpoint == "/predict":
                 self.in_flight -= 1
@@ -138,6 +165,8 @@ class Metrics:
                     self.model_rows.get(model, 0) + rows
             if endpoint == "/predict" and code == 200:
                 self.latency.observe(seconds)
+                if lane is not None:
+                    self._lane_observe(lane, seconds)
 
     def batch_dispatched(self, n_items: int, n_rows: int) -> None:
         with self._lock:
@@ -163,11 +192,13 @@ class Metrics:
     def render(self, forest: ServingForest, degraded: bool = False,
                inflight_rows: int = 0,
                models: Optional[List[Dict[str, Any]]] = None,
-               worker: Optional[Tuple[int, int]] = None) -> bytes:
+               worker: Optional[Tuple[int, int]] = None,
+               queue_depth: int = 0) -> bytes:
         """Prometheus text.  `forest` is the DEFAULT model (its gauges
         keep their historical unlabeled names); `models` is the fleet
         listing (per-model labeled series); `worker` is (index, pid)
-        when this process runs behind the multi-process front-end."""
+        when this process runs behind the multi-process front-end;
+        `queue_depth` is the batcher's live segment count."""
         out: List[str] = []
         with self._lock:
             out.append("# HELP lgbm_serve_requests_total "
@@ -222,6 +253,19 @@ class Metrics:
                        "the JAX-free native predictor")
             out.append("# TYPE lgbm_serve_degraded gauge")
             out.append("lgbm_serve_degraded %d" % int(degraded))
+            out.append("# HELP lgbm_serve_lane_requests_total "
+                       "predict requests by admission lane (fast = "
+                       "synchronous low-latency dispatch, batch = "
+                       "coalesced micro-batch)")
+            out.append("# TYPE lgbm_serve_lane_requests_total counter")
+            for lane in sorted(self.lane_requests):
+                out.append('lgbm_serve_lane_requests_total{lane="%s"} %d'
+                           % (lane, self.lane_requests[lane]))
+            out.append("# HELP lgbm_serve_batcher_queue_depth "
+                       "request segments waiting in the micro-batcher "
+                       "queue")
+            out.append("# TYPE lgbm_serve_batcher_queue_depth gauge")
+            out.append("lgbm_serve_batcher_queue_depth %d" % queue_depth)
             out.append("# HELP lgbm_serve_in_flight "
                        "requests currently being handled")
             out.append("# TYPE lgbm_serve_in_flight gauge")
@@ -291,6 +335,11 @@ class Metrics:
                            % worker)
             self.latency.render("lgbm_serve_request_latency_seconds",
                                 "predict request latency", out)
+            for i, lane in enumerate(sorted(self.lane_latency)):
+                self.lane_latency[lane].render(
+                    "lgbm_serve_lane_latency_seconds",
+                    "predict request latency by admission lane", out,
+                    labels='lane="%s"' % lane, with_meta=(i == 0))
             self.batch_rows.render("lgbm_serve_batch_rows",
                                    "rows per coalesced dispatch", out)
         return ("\n".join(out) + "\n").encode()
@@ -416,6 +465,19 @@ class ServingState:
         # whether the streak above saw a matmul-routed failure: stage 1
         # (disable matmul) only makes sense when matmul is implicated
         self._streak_saw_matmul: Dict[Tuple[str, int], bool] = {}
+        # latency-class admission lane (serve_low_latency): requests at
+        # or below the effective row bound never enter the batcher —
+        # they dispatch synchronously on the jax-free flat-table engine
+        # (or the fused native kernel for text), so a single row never
+        # waits out a coalescing window behind a forming batch.  auto
+        # clamps the bound below the matmul threshold so the lane can
+        # never eat a batch the device route is configured to serve
+        # (=on with a contradictory bound is a config-load fatal).
+        lane_rows = cfg.serve_low_latency_max_rows
+        if cfg.serve_low_latency == "auto":
+            lane_rows = min(lane_rows, cfg.serve_matmul_min_rows - 1)
+        self.lane_max_rows = (0 if cfg.serve_low_latency == "off"
+                              else max(0, lane_rows))
         self.batcher = MicroBatcher(
             self._run_batch, cfg.serve_max_batch_rows,
             cfg.serve_batch_timeout_ms,
@@ -545,6 +607,43 @@ class ServingState:
                         "consecutive device-dispatch failures — "
                         "serving on the JAX-free native predictor "
                         "until /reload" % n)
+
+    # -- the low-latency lane (synchronous, handler thread) ------------
+    def fast_lane(self, nrows: int) -> bool:
+        """Admission-lane routing: does an nrows request bypass the
+        coalescing window?"""
+        return nrows <= self.lane_max_rows
+
+    def fast_predict(self, forest: ServingForest, payload: Any,
+                     mode: str) -> List[bytes]:
+        """One request answered NOW, on the handler thread: no batcher
+        queue, no coalescing wait, no device dispatch.  Text bodies
+        take the fused native kernel (parse -> descend -> format in
+        one pass — the single-row fast path); parsed rows take the
+        flat-table descent.  Both are jax-free and byte-identical to
+        the batch path by construction (the flat table ranks against
+        the same threshold tables as the device packs), so lane
+        routing can never change a response byte."""
+        if isinstance(payload, TextPayload):
+            if payload.nrows:
+                try:
+                    got = forest.predict_text(payload.text, payload.fmt,
+                                              payload.sep, mode)
+                except log.LightGBMError:
+                    # malformed token: redo on the parse path below so
+                    # the error surfaces exactly like the batch path's
+                    # per-item isolation
+                    got = None
+                if got is not None:
+                    return [got[0]]
+            # no native kernel (or 0 rows): parse + flat descent, the
+            # same fallback order as the batch path's text dispatch
+            feats = _parse_text_rows(payload.text, forest)
+            res = forest.predict(feats, mode, engine="flat")
+            return [forest.format_rows(res, mode)]
+        feats = forest.fit_width(payload.feats)
+        res = forest.predict(feats, mode, engine="flat")
+        return [forest.format_rows(res, mode)]
 
     # -- the coalesced dispatch (MicroBatcher worker thread) -----------
     # Batches key on (forest, mode, family): the forest object isolates
@@ -767,7 +866,8 @@ def _make_handler(state: ServingState) -> type:
                         200, state.metrics.render(
                             state.forest, degraded=state.degraded,
                             inflight_rows=state.inflight_rows,
-                            models=state.fleet.info(), worker=worker),
+                            models=state.fleet.info(), worker=worker,
+                            queue_depth=state.batcher.queue_depth()),
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     code = 404
@@ -784,9 +884,10 @@ def _make_handler(state: ServingState) -> type:
             state.metrics.request_started(path)
             code, rows = 200, 0
             model: Optional[Tuple[str, str]] = None
+            lane: Optional[str] = None
             try:
                 if path == "/predict":
-                    code, rows, model = self._predict(url)
+                    code, rows, model, lane = self._predict(url)
                 elif path == "/reload":
                     code = self._reload(url)
                 else:
@@ -806,10 +907,12 @@ def _make_handler(state: ServingState) -> type:
             finally:
                 state.metrics.request_finished(path, code,
                                                time.monotonic() - t0,
-                                               rows, model=model)
+                                               rows, model=model,
+                                               lane=lane)
 
         def _predict(self, url: ParseResult) \
-                -> Tuple[int, int, Optional[Tuple[str, str]]]:
+                -> Tuple[int, int, Optional[Tuple[str, str]],
+                         Optional[str]]:
             # read the body FIRST even on early-exit paths: an unread
             # body desyncs the next request on a keep-alive connection
             body = self._body()
@@ -819,7 +922,7 @@ def _make_handler(state: ServingState) -> type:
                 self._respond(503, _error_json(
                     RuntimeError("draining")), "application/json",
                     headers=retry_hdr)
-                return 503, 0, None
+                return 503, 0, None, None
             q = parse_qs(url.query)
             mode = q.get("mode", ["normal"])[0].lower()
             if mode not in MODES:
@@ -856,7 +959,7 @@ def _make_handler(state: ServingState) -> type:
                     "retry later" % (state.inflight_rows,
                                      state.max_inflight_rows))),
                     "application/json", headers=retry_hdr)
-                return 503, 0, mlabel
+                return 503, 0, mlabel, None
             try:
                 if is_json:
                     payload = RowsPayload(_parse_json_rows(body))
@@ -875,20 +978,27 @@ def _make_handler(state: ServingState) -> type:
                     # low — like the idle-server oversized case)
                     state.release(admitted - nrows)
                     admitted = nrows
-                parts = state.batcher.submit((forest, mode, family),
-                                             payload)
+                if state.fast_lane(nrows):
+                    # low-latency lane: answer on THIS thread, never
+                    # queued behind a forming batch
+                    lane = "fast"
+                    parts = state.fast_predict(forest, payload, mode)
+                else:
+                    lane = "batch"
+                    parts = state.batcher.submit((forest, mode, family),
+                                                 payload)
             except BatcherClosed:
                 # raced the drain past the flag check above
                 self._respond(503, _error_json(
                     RuntimeError("draining")), "application/json",
                     headers=retry_hdr)
-                return 503, 0, mlabel
+                return 503, 0, mlabel, None
             except log.LightGBMError as ex:
                 raise BadRequest(str(ex))
             finally:
                 state.release(admitted)
             self._respond(200, b"".join(parts))
-            return 200, nrows, mlabel
+            return 200, nrows, mlabel, lane
 
         def _reload(self, url: ParseResult) -> int:
             body = self._body()
@@ -999,14 +1109,20 @@ class ServingServer:
                  % (forest.engine, forest.num_models, n_buckets,
                     time.time() - t0))
         self.state = ServingState(cfg, forest, worker_index=worker_index)
+        log.info("Serve lane: low-latency %s (<= %d rows synchronous, "
+                 "flat table %s)"
+                 % (cfg.serve_low_latency, self.state.lane_max_rows,
+                    "ready" if forest.flat_ready else "lazy"))
         # fleet preload: every serve_models path registers; the ones
         # that fit the warm pool parse + warm NOW so the first
-        # /predict?model= request pays no cold start
+        # /predict?model= request pays no cold start.  Preloads warm
+        # EAGERLY (startup is the time to pay bucket compiles) — only
+        # on-demand cold hits take the fleet's lazy warm.
         for path in self.state.fleet.registered_paths():
             if path != forest.source \
                     and len(self.state.fleet.warm_models()) \
                     < cfg.serve_fleet_max_models:
-                self.state.fleet.get(path)
+                self.state.fleet.get(path).warm(cfg.serve_max_batch_rows)
         self.httpd = _HTTPServer((cfg.serve_host, cfg.serve_port),
                                  _make_handler(self.state),
                                  reuse_port=reuse_port)

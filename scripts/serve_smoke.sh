@@ -135,6 +135,27 @@ for needle in ("lgbm_serve_rows_total 25",
     if needle not in metrics:
         fail("metrics scrape missing %r" % needle)
 
+# -- single-row low-latency lane: byte-compare vs task=predict ---------
+# a 1-row request routes through the synchronous fast lane (the 25-row
+# body above exceeded the lane bound and batched); its bytes must be
+# the matching line of task=predict's output
+import time as _time
+one = body.split(b"\n", 1)[0] + b"\n"
+want_one = want_a.split(b"\n", 1)[0] + b"\n"
+t0 = _time.monotonic()
+got_one = post("/predict", one)
+lat_ms = (_time.monotonic() - t0) * 1e3
+if got_one != want_one:
+    fail("fast-lane single-row bytes differ from task=predict")
+metrics = urllib.request.urlopen(base + "/metrics", timeout=60).read().decode()
+for needle in ('lgbm_serve_lane_requests_total{lane="fast"} 1',
+               'lgbm_serve_lane_requests_total{lane="batch"} 1',
+               "lgbm_serve_batcher_queue_depth 0",
+               'lgbm_serve_lane_latency_seconds_count{lane="fast"} 1'):
+    if needle not in metrics:
+        fail("lane metrics scrape missing %r" % needle)
+print("serve_smoke: fast-lane single row OK (%.2f ms)" % lat_ms)
+
 info = json.loads(post("/reload",
                        json.dumps({"model": work + "/model_b.txt"}).encode(),
                        "application/json"))

@@ -364,15 +364,22 @@ def test_healthz_and_metrics_shape(tmp_path):
         doc = json.loads(health)
         assert doc["status"] == "ok"
         assert doc["model"]["num_models"] == 3
-        post(srv.url, "/predict", _tsv_body(_rows(n=5)))
+        post(srv.url, "/predict", _tsv_body(_rows(n=5)))     # fast lane
+        post(srv.url, "/predict", _tsv_body(_rows(n=20)))    # batch lane
         st, metrics = get(srv.url, "/metrics")
     m = metrics.decode()
     assert st == 200
-    assert 'lgbm_serve_requests_total{endpoint="/predict",code="200"} 1' in m
-    assert "lgbm_serve_rows_total 5" in m
+    assert 'lgbm_serve_requests_total{endpoint="/predict",code="200"} 2' in m
+    assert "lgbm_serve_rows_total 25" in m
     assert "lgbm_serve_in_flight 0" in m
-    assert "lgbm_serve_request_latency_seconds_count 1" in m
-    assert 'lgbm_serve_batch_rows_bucket{le="8"} 1' in m
+    assert "lgbm_serve_request_latency_seconds_count 2" in m
+    # only the batch-lane request coalesces: 5-row went synchronous
+    assert 'lgbm_serve_batch_rows_bucket{le="32"} 1' in m
+    assert "lgbm_serve_batch_rows_count 1" in m
+    assert 'lgbm_serve_lane_requests_total{lane="fast"} 1' in m
+    assert 'lgbm_serve_lane_requests_total{lane="batch"} 1' in m
+    assert "lgbm_serve_batcher_queue_depth 0" in m
+    assert 'lgbm_serve_lane_latency_seconds_count{lane="fast"} 1' in m
     assert "lgbm_serve_model_num_trees 3" in m
 
 

@@ -1,0 +1,429 @@
+"""The low-latency serving tier: latency-class admission lane, the flat
+quantized node-array engine, and the scaled fleet pool.
+
+The load-bearing invariant is BYTE IDENTITY: lane routing is an
+admission decision, never a numeric one.  A request's response bytes
+must not depend on which lane served it, which engine descended the
+trees, or how many workers the server runs — all three routes (flat
+table, device/host batch path, task=predict) rank-encode against the
+SAME threshold tables, and these tests pin the bytes across the matrix
+{normal,raw,leaf} x {TSV,JSON} x {fast,batch,cli}, including 0-row,
+the lane boundary, oversize splits, and breaker-degraded states.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.serving.fleet import ModelFleet
+from lightgbm_tpu.serving.forest import ServingForest
+from lightgbm_tpu.utils import log
+
+from test_predict_fast import BINARY_MODEL, MULTI_MODEL, _rows
+from test_serving import (_tsv_body, _write, cli_predict, get, post,
+                          serve)
+
+# every test in this module must leave no worker threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+MODES = ("normal", "raw", "leaf")
+
+
+def _scrape(url, needle):
+    """Value of the first /metrics line starting with `needle`."""
+    _, m = get(url, "/metrics")
+    for ln in m.decode().splitlines():
+        if ln.startswith(needle + " "):
+            return float(ln.rsplit(" ", 1)[1])
+    raise AssertionError("metric %r not in scrape" % needle)
+
+
+def _lane_counts(url):
+    return (int(_scrape(url, 'lgbm_serve_lane_requests_total{lane="fast"}')),
+            int(_scrape(url, 'lgbm_serve_lane_requests_total{lane="batch"}')))
+
+
+# ---------------------------------------------------------------------------
+# byte-identity matrix: fast lane vs batch lane vs task=predict
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["native", "auto"])
+@pytest.mark.parametrize("mode", MODES)
+def test_fast_lane_matches_batch_and_cli(tmp_path, backend, mode):
+    """Single-digit-row requests through the fast lane return the exact
+    bytes of (a) the same request on a lane-off server (batch path) and
+    (b) task=predict — TSV and JSON bodies both."""
+    x = np.random.RandomState(3).randn(3, 4)
+    tsv = ("\n".join("0\t" + "\t".join(repr(float(v)) for v in row)
+                     for row in x) + "\n").encode()
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    data = _write(tmp_path / "d.tsv", tsv.decode())
+    want = cli_predict(tmp_path, model, data, mode)
+    jbody = json.dumps({"rows": x.tolist()}).encode()
+    with serve(model, serve_backend=backend) as on:
+        st, fast_tsv = post(on.url, "/predict?mode=" + mode, tsv)
+        st2, fast_json = post(on.url, "/predict?mode=" + mode, jbody,
+                              "application/json")
+        fast_n, batch_n = _lane_counts(on.url)
+    assert st == st2 == 200
+    assert fast_n == 2 and batch_n == 0  # really took the fast lane
+    with serve(model, serve_backend=backend,
+               serve_low_latency="off") as off:
+        assert off.state.lane_max_rows == 0
+        st3, batch_tsv = post(off.url, "/predict?mode=" + mode, tsv)
+        st4, batch_json = post(off.url, "/predict?mode=" + mode, jbody,
+                               "application/json")
+        fast_n, batch_n = _lane_counts(off.url)
+    assert st3 == st4 == 200
+    assert fast_n == 0 and batch_n == 2  # lane off: everything batches
+    assert fast_tsv == batch_tsv == want, (backend, mode)
+    assert fast_json == batch_json == want, (backend, mode)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_fast_lane_zero_rows(tmp_path, mode):
+    """0-row requests are admitted to the fast lane (0 <= bound) and
+    return the same empty-body 200 as the batch path."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model, serve_backend="native") as srv:
+        for body, ctype in ((b"", "text/plain"),
+                            (b"\n\n", "text/plain"),
+                            (b'{"rows": []}', "application/json")):
+            st, out = post(srv.url, "/predict?mode=" + mode, body, ctype)
+            assert st == 200 and out == b"", (body, ctype)
+        fast_n, batch_n = _lane_counts(srv.url)
+    assert fast_n == 3 and batch_n == 0
+
+
+def test_fast_lane_multiclass_matches_cli(tmp_path):
+    rows = _rows(n=2, f=3)
+    model = _write(tmp_path / "m.txt", MULTI_MODEL)
+    data = _write(tmp_path / "d.tsv", _tsv_body(rows).decode())
+    for mode in ("normal", "raw"):
+        want = cli_predict(tmp_path, model, data, mode)
+        with serve(model, serve_backend="native") as srv:
+            st, got = post(srv.url, "/predict?mode=" + mode,
+                           _tsv_body(rows))
+        assert st == 200 and got == want, mode
+
+
+# ---------------------------------------------------------------------------
+# the admission boundary
+# ---------------------------------------------------------------------------
+
+def test_lane_boundary_routing(tmp_path):
+    """Exactly serve_low_latency_max_rows rows goes fast; one more row
+    goes to the batcher — and both return task=predict's bytes."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model, serve_backend="native",
+               serve_low_latency_max_rows=4) as srv:
+        assert srv.state.lane_max_rows == 4
+        for n, want_lanes in ((4, (1, 0)), (5, (1, 1))):
+            rows = _rows(n=n)
+            data = _write(tmp_path / ("d%d.tsv" % n),
+                          _tsv_body(rows).decode())
+            want = cli_predict(tmp_path, model, data, "normal")
+            st, got = post(srv.url, "/predict", _tsv_body(rows))
+            assert st == 200 and got == want, n
+            assert _lane_counts(srv.url) == want_lanes, n
+        # lane latency histograms carry one observation per lane, in
+        # the sub-ms buckets the widened histogram now has
+        _, m = get(srv.url, "/metrics")
+        txt = m.decode()
+        assert 'lgbm_serve_lane_latency_seconds_count{lane="fast"} 1' \
+            in txt
+        assert 'lgbm_serve_lane_latency_seconds_count{lane="batch"} 1' \
+            in txt
+        assert 'le="0.0001"' in txt and 'le="0.00025"' in txt
+        assert "lgbm_serve_batcher_queue_depth 0" in txt
+
+
+def test_oversize_request_splits_with_lane_on(tmp_path):
+    """A request far past serve_max_batch_rows still splits/reassembles
+    byte-identically with the lane enabled (it must route batch)."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    data = _write(tmp_path / "d.tsv", _tsv_body(_rows(n=60)).decode())
+    want = cli_predict(tmp_path, model, data, "normal")
+    with open(data, "rb") as f:
+        body = f.read()
+    with serve(model, serve_backend="native",
+               serve_max_batch_rows=8) as srv:
+        st, got = post(srv.url, "/predict", body)
+        fast_n, batch_n = _lane_counts(srv.url)
+    assert st == 200 and got == want
+    assert (fast_n, batch_n) == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# breaker-degraded parity
+# ---------------------------------------------------------------------------
+
+def test_fast_lane_parity_across_breaker_degradation(tmp_path):
+    """The flat engine never touches the breaker ladder: fast-lane
+    bytes before, during, and after degradation are identical, and the
+    degraded batch path still agrees with them."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    one = _tsv_body(_rows(n=1))
+    many = _tsv_body(_rows(n=24))
+    with serve(model) as srv:                   # jax backend
+        _, fast_before = post(srv.url, "/predict", one)
+        _, batch_before = post(srv.url, "/predict", many)
+        srv.state.forest.disable_matmul()
+        srv.state.forest.degrade()              # breaker floor: host
+        assert srv.state.forest.degraded
+        _, fast_after = post(srv.url, "/predict", one)
+        _, batch_after = post(srv.url, "/predict", many)
+    assert fast_after == fast_before
+    assert batch_after == batch_before
+
+
+# ---------------------------------------------------------------------------
+# the pinned no-wait guarantee
+# ---------------------------------------------------------------------------
+
+def test_fast_lane_never_waits_for_the_window(tmp_path):
+    """A single-row request completes while the coalescing window is
+    PROVABLY still open: a batch-lane request sits queued behind a
+    30 s timeout, and the fast request returns in well under that with
+    the queue still occupied."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    results = []
+    with serve(model, serve_backend="native",
+               serve_batch_timeout_ms=30000,
+               serve_max_batch_rows=256) as srv:
+        t = threading.Thread(
+            target=lambda: results.append(
+                post(srv.url, "/predict", _tsv_body(_rows(n=20)))))
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _scrape(srv.url, "lgbm_serve_batcher_queue_depth") >= 1:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("batch request never reached the queue")
+        t0 = time.monotonic()
+        st, got = post(srv.url, "/predict", _tsv_body(_rows(n=1)))
+        elapsed = time.monotonic() - t0
+        assert st == 200 and got
+        # the window is 30 s; the fast lane answered in a fraction of
+        # it, with the batch segment STILL queued
+        assert elapsed < 5.0
+        assert _scrape(srv.url, "lgbm_serve_batcher_queue_depth") >= 1
+        # shutdown drains the queued segment (the drain contract), so
+        # the batch client completes normally on exit
+    t.join(30)
+    assert results and results[0][0] == 200
+
+
+# ---------------------------------------------------------------------------
+# flat engine: bitwise parity with the jax and host engines
+# ---------------------------------------------------------------------------
+
+def _adversarial_rows(n, f, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f) * 2.0
+    x.flat[::7] = np.nan          # NaN -> default direction
+    x.flat[1::11] = -0.0          # signed zero ranks with +0.0
+    return x
+
+
+@pytest.mark.parametrize("model_text,f", [(BINARY_MODEL, 4),
+                                          (MULTI_MODEL, 3)])
+def test_flat_engine_bitwise_parity(model_text, f):
+    jf = ServingForest(model_text, backend="jax")
+    hf = ServingForest(model_text, backend="native")
+    for n in (0, 1, 5, 33):
+        x = _adversarial_rows(n, f, seed=n)
+        for mode in MODES:
+            flat = hf.predict(x, mode, engine="flat")
+            host = hf.predict(x, mode, engine="host")
+            dev = jf.predict(x, mode)
+            assert flat.dtype == host.dtype
+            np.testing.assert_array_equal(flat, host)
+            np.testing.assert_array_equal(flat, dev)
+            assert hf.format_rows(flat, mode) \
+                == hf.format_rows(host, mode) \
+                == jf.format_rows(dev, mode), (mode, n)
+
+
+def test_flat_engine_exact_threshold_boundaries():
+    """Values at, just below, and just above every split threshold
+    descend identically on the flat and host engines (the exact-f64
+    rank-encoding contract: code(x) <= rank(t) <=> x <= t)."""
+    hf = ServingForest(BINARY_MODEL, backend="native")
+    probes = []
+    _, thr, _, _, _ = hf._flat_arrays()
+    vals = sorted({float(v) for v in np.asarray(thr).ravel()
+                   if np.isfinite(v)})
+    for v in vals:
+        probes += [v, np.nextafter(v, -np.inf), np.nextafter(v, np.inf)]
+    width = hf.max_feature_idx + 1
+    x = np.array([[p] * width for p in probes], dtype=np.float64)
+    np.testing.assert_array_equal(hf.predict(x, "leaf", engine="flat"),
+                                  hf.predict(x, "leaf", engine="host"))
+
+
+def test_warm_builds_flat_table_and_reports_size():
+    hf = ServingForest(BINARY_MODEL, backend="native")
+    assert not hf.flat_ready
+    hf.warm(64)
+    assert hf.flat_ready
+    info = hf.info()
+    assert info["flat"] is True and info["flat_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet scale-out: many models, bounded cold hits, age eviction
+# ---------------------------------------------------------------------------
+
+def _fleet_models(tmp_path, n):
+    paths = []
+    for i in range(n):
+        text = BINARY_MODEL.replace(
+            "leaf_value=0.2 -0.13 0.34",
+            "leaf_value=0.2 -0.13 %.6f" % (0.3 + i * 1e-3))
+        p = tmp_path / ("m%03d.txt" % i)
+        p.write_text(text)
+        paths.append(str(p))
+    return paths
+
+
+def test_fleet_many_models_cold_hits_bounded(tmp_path):
+    """64 registered models churned through a 16-slot pool: the first
+    sweep cold-loads each model exactly once, and re-getting the warm
+    residents costs ZERO further cold loads (instance identity)."""
+    paths = _fleet_models(tmp_path, 64)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths[0],
+        "serve_backend": "native", "serve_fleet_max_models": "16"})
+    default = ServingForest(BINARY_MODEL, backend="native",
+                            source=paths[0])
+    fleet = ModelFleet(cfg, default)
+    for p in paths[1:]:
+        fleet.register(p)
+    seen = set()
+    for p in paths:
+        seen.add(fleet.get(p).identity)
+    assert len(seen) == 64                     # one cold load each
+    assert len(fleet.warm_models()) == 16      # pool stayed bounded
+    # warm residents: the default + the 15 most recent registrations
+    warm = [f for f in fleet.warm_models()]
+    resident = sorted(f.source for f in warm)
+    assert resident == sorted([paths[0]] + paths[-15:])
+    # hot phase — zero cold hits on the residents
+    instances = {f.source: f for f in warm}
+    for _ in range(3):
+        for p in paths[-15:]:
+            assert fleet.get(p) is instances[p]
+    assert len(fleet.warm_models()) == 16
+
+
+def test_fleet_lazy_warm_serves_flat_first(tmp_path):
+    """Cold fleet loads warm LAZILY: the flat table (the fast lane's
+    engine) is ready immediately after get()."""
+    paths = _fleet_models(tmp_path, 2)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths[0],
+        "serve_backend": "native", "serve_fleet_max_models": "4"})
+    default = ServingForest(BINARY_MODEL, backend="native",
+                            source=paths[0])
+    fleet = ModelFleet(cfg, default)
+    fleet.register(paths[1])
+    assert fleet.get(paths[1]).flat_ready
+
+
+def test_fleet_age_eviction(tmp_path):
+    """Idle non-default models past serve_fleet_evict_age_s leave the
+    warm pool (stay registered); the default is never age-evicted."""
+    paths = _fleet_models(tmp_path, 3)
+    cfg = Config.from_params({
+        "task": "serve", "input_model": paths[0],
+        "serve_backend": "native", "serve_fleet_max_models": "8",
+        "serve_fleet_evict_age_s": "0.05"})
+    default = ServingForest(BINARY_MODEL, backend="native",
+                            source=paths[0])
+    fleet = ModelFleet(cfg, default)
+    fleet.register(paths[1])
+    fleet.register(paths[2])
+    f1 = fleet.get(paths[1])
+    time.sleep(0.12)
+    f2 = fleet.get(paths[2])    # touching the fleet sweeps stale ages
+    warm = fleet.warm_models()
+    assert f2 in warm and f1 not in warm
+    assert any(f.source == paths[0] for f in warm)  # default pinned
+    # evicted model stays registered: next get cold-loads a fresh one
+    f1b = fleet.get(paths[1])
+    assert f1b.content_sha == f1.content_sha
+    assert f1b.identity != f1.identity
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def test_config_rejects_unknown_lane_mode():
+    with pytest.raises(log.LightGBMError, match="serve_low_latency"):
+        Config.from_params({"serve_low_latency": "maybe"})
+
+
+def test_config_rejects_bad_lane_rows():
+    with pytest.raises(log.LightGBMError,
+                       match="serve_low_latency_max_rows"):
+        Config.from_params({"serve_low_latency_max_rows": "0"})
+
+
+def test_config_rejects_negative_evict_age():
+    with pytest.raises(log.LightGBMError,
+                       match="serve_fleet_evict_age_s"):
+        Config.from_params({"serve_fleet_evict_age_s": "-1"})
+
+
+def test_config_rejects_forced_lane_at_matmul_threshold():
+    """serve_low_latency=on with a lane bound at/above the matmul
+    threshold is contradictory routing — fatal, not silent precedence."""
+    with pytest.raises(log.LightGBMError, match="must be below"):
+        Config.from_params({"serve_low_latency": "on",
+                            "serve_low_latency_max_rows": "32",
+                            "serve_matmul_min_rows": "32"})
+    # auto with the same numbers CLAMPS instead of failing
+    cfg = Config.from_params({"serve_low_latency": "auto",
+                              "serve_low_latency_max_rows": "32",
+                              "serve_matmul_min_rows": "32"})
+    assert cfg.serve_low_latency == "auto"
+
+
+def test_auto_lane_clamps_below_matmul_threshold(tmp_path):
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model, serve_backend="native",
+               serve_matmul_min_rows=8) as srv:
+        assert srv.state.lane_max_rows == 7
+    with serve(model, serve_backend="native",
+               serve_low_latency="off") as srv:
+        assert srv.state.lane_max_rows == 0
+        st, _ = post(srv.url, "/predict", _tsv_body(_rows(n=1)))
+        assert st == 200              # off still serves, via batch
+        assert _lane_counts(srv.url) == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# steady state: the fast lane never compiles
+# ---------------------------------------------------------------------------
+
+def test_fast_lane_steady_state_zero_compiles(tmp_path, xla_guard):
+    """Fast-lane traffic on a native-backend server is jax-free end to
+    end: zero XLA compilations across warm single-row serving."""
+    model = _write(tmp_path / "m.txt", BINARY_MODEL)
+    with serve(model, serve_backend="native") as srv:
+        with xla_guard(0, what="fast-lane steady state"):
+            for i in range(6):
+                st, out = post(srv.url, "/predict",
+                               _tsv_body(_rows(n=1 + (i % 3))))
+                assert st == 200 and out
+        fast_n, batch_n = _lane_counts(srv.url)
+    assert fast_n == 6 and batch_n == 0
